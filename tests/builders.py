@@ -1,0 +1,112 @@
+"""Test object builders.
+
+Mirrors the reference's test fixtures (pkg/scheduler/util/test_utils.go:
+BuildPod/BuildNode/BuildResourceList) so scheduler tests read the same way.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+from volcano_tpu.apis import core, scheduling
+
+_uid = itertools.count(1)
+_ts = itertools.count(1)
+
+
+def build_node(
+    name: str,
+    alloc: Dict[str, object],
+    labels: Optional[Dict[str, str]] = None,
+    taints: Optional[List[core.Taint]] = None,
+    capacity: Optional[Dict[str, object]] = None,
+    unschedulable: bool = False,
+) -> core.Node:
+    alloc = dict(alloc)
+    alloc.setdefault("pods", 110)
+    return core.Node(
+        metadata=core.ObjectMeta(
+            name=name,
+            namespace="",
+            uid=f"node-{next(_uid)}",
+            labels=labels or {},
+            creation_timestamp=float(next(_ts)),
+        ),
+        spec=core.NodeSpec(taints=taints or [], unschedulable=unschedulable),
+        status=core.NodeStatus(allocatable=alloc, capacity=dict(capacity or alloc)),
+    )
+
+
+def build_pod(
+    namespace: str,
+    name: str,
+    node_name: str,
+    req: Dict[str, object],
+    phase: str = "Pending",
+    group: str = "",
+    labels: Optional[Dict[str, str]] = None,
+    selector: Optional[Dict[str, str]] = None,
+    priority: Optional[int] = None,
+    tolerations: Optional[List[core.Toleration]] = None,
+    affinity: Optional[Dict[str, object]] = None,
+    ports: Optional[List[int]] = None,
+) -> core.Pod:
+    annotations = {}
+    if group:
+        annotations[scheduling.GROUP_NAME_ANNOTATION_KEY] = group
+    container = core.Container(
+        name="main",
+        resources={"requests": dict(req)} if req else {},
+        ports=[core.ContainerPort(container_port=p, host_port=p) for p in ports or []],
+    )
+    return core.Pod(
+        metadata=core.ObjectMeta(
+            name=name,
+            namespace=namespace,
+            uid=f"pod-{next(_uid)}",
+            labels=labels or {},
+            annotations=annotations,
+            creation_timestamp=float(next(_ts)),
+        ),
+        spec=core.PodSpec(
+            containers=[container],
+            node_name=node_name,
+            node_selector=selector or {},
+            tolerations=tolerations or [],
+            affinity=affinity or {},
+            priority=priority,
+        ),
+        status=core.PodStatus(phase=phase),
+    )
+
+
+def build_pod_group(
+    namespace: str,
+    name: str,
+    min_member: int,
+    queue: str = "default",
+    phase: str = scheduling.POD_GROUP_INQUEUE,
+    min_resources: Optional[Dict[str, object]] = None,
+) -> scheduling.PodGroup:
+    return scheduling.PodGroup(
+        metadata=core.ObjectMeta(
+            name=name,
+            namespace=namespace,
+            uid=f"pg-{next(_uid)}",
+            creation_timestamp=float(next(_ts)),
+        ),
+        spec=scheduling.PodGroupSpec(
+            min_member=min_member, queue=queue, min_resources=min_resources or {}
+        ),
+        status=scheduling.PodGroupStatus(phase=phase),
+    )
+
+
+def build_queue(name: str, weight: int = 1, capability: Optional[Dict] = None) -> scheduling.Queue:
+    return scheduling.Queue(
+        metadata=core.ObjectMeta(
+            name=name, namespace="", uid=f"q-{next(_uid)}", creation_timestamp=float(next(_ts))
+        ),
+        spec=scheduling.QueueSpec(weight=weight, capability=capability or {}),
+    )

@@ -1,0 +1,134 @@
+"""Job/Task/Node/Queue info model tests.
+
+Mirrors pkg/scheduler/api/{job_info,node_info,namespace_info}_test.go.
+"""
+
+import pytest
+
+from volcano_tpu.api import (
+    JobInfo,
+    NamespaceCollection,
+    NodeInfo,
+    TaskStatus,
+    new_task_info,
+)
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.apis import core, scheduling
+from tests.builders import build_node, build_pod
+
+
+class TestTaskInfo:
+    def test_new_task_info_requests(self):
+        pod = build_pod("ns1", "p1", "", {"cpu": "1", "memory": "1Gi"})
+        task = new_task_info(pod)
+        assert task.resreq.milli_cpu == 1000
+        assert task.resreq.memory == 1024**3
+        assert task.status == TaskStatus.Pending
+        assert not task.best_effort
+
+    def test_status_mapping(self):
+        running = build_pod("ns1", "p1", "n1", {"cpu": "1"}, phase="Running")
+        assert new_task_info(running).status == TaskStatus.Running
+        bound = build_pod("ns1", "p2", "n1", {"cpu": "1"}, phase="Pending")
+        assert new_task_info(bound).status == TaskStatus.Bound
+        pending = build_pod("ns1", "p3", "", {"cpu": "1"}, phase="Pending")
+        assert new_task_info(pending).status == TaskStatus.Pending
+
+    def test_job_id_from_annotation(self):
+        pod = build_pod("ns1", "p1", "", {"cpu": "1"}, group="pg1")
+        assert new_task_info(pod).job == "ns1/pg1"
+
+
+class TestJobInfo:
+    def _job_with_tasks(self, statuses):
+        job = JobInfo("ns1/j1", "j1", "ns1")
+        job.min_available = 2
+        for i, status in enumerate(statuses):
+            pod = build_pod("ns1", f"p{i}", "n1" if status != TaskStatus.Pending else "", {"cpu": "1"})
+            task = new_task_info(pod)
+            task.status = status
+            job.add_task_info(task)
+        return job
+
+    def test_add_task_updates_rollups(self):
+        job = self._job_with_tasks([TaskStatus.Pending, TaskStatus.Running])
+        assert job.allocated.milli_cpu == 1000  # only Running is occupied
+        assert job.total_request.milli_cpu == 2000
+
+    def test_ready_and_pipelined(self):
+        job = self._job_with_tasks([TaskStatus.Running, TaskStatus.Running])
+        assert job.ready()
+        job2 = self._job_with_tasks([TaskStatus.Running, TaskStatus.Pipelined])
+        assert not job2.ready()
+        assert job2.pipelined()
+
+    def test_valid_task_num_excludes_failed(self):
+        job = self._job_with_tasks(
+            [TaskStatus.Pending, TaskStatus.Failed, TaskStatus.Succeeded]
+        )
+        assert job.valid_task_num() == 2
+
+    def test_update_task_status_moves_buckets(self):
+        job = self._job_with_tasks([TaskStatus.Pending])
+        task = next(iter(job.tasks.values()))
+        job.update_task_status(task, TaskStatus.Allocated)
+        assert TaskStatus.Pending not in job.task_status_index
+        assert job.allocated.milli_cpu == 1000
+
+    def test_delete_task(self):
+        job = self._job_with_tasks([TaskStatus.Running])
+        task = next(iter(job.tasks.values()))
+        job.delete_task_info(task)
+        assert not job.tasks
+        assert job.allocated.milli_cpu == 0
+
+
+class TestNodeInfo:
+    def test_add_remove_task_accounting(self):
+        ni = NodeInfo(build_node("n1", {"cpu": "4", "memory": "8Gi"}))
+        pod = build_pod("ns1", "p1", "n1", {"cpu": "1", "memory": "1Gi"})
+        task = new_task_info(pod)
+        task.status = TaskStatus.Running
+        ni.add_task(task)
+        assert ni.idle.milli_cpu == 3000
+        assert ni.used.milli_cpu == 1000
+        ni.remove_task(task)
+        assert ni.idle.milli_cpu == 4000
+        assert ni.used.milli_cpu == 0
+
+    def test_releasing_and_pipelined_future_idle(self):
+        ni = NodeInfo(build_node("n1", {"cpu": "4", "memory": "8Gi"}))
+        releasing = new_task_info(build_pod("ns1", "r", "n1", {"cpu": "2"}))
+        releasing.status = TaskStatus.Releasing
+        ni.add_task(releasing)
+        pipelined = new_task_info(build_pod("ns1", "q", "n1", {"cpu": "1"}))
+        pipelined.status = TaskStatus.Pipelined
+        ni.add_task(pipelined)
+        # idle=2, releasing=2, pipelined=1 → future idle cpu = 3
+        assert ni.idle.milli_cpu == 2000
+        assert ni.future_idle().milli_cpu == 3000
+
+    def test_over_allocate_marks_not_ready(self):
+        ni = NodeInfo(build_node("n1", {"cpu": "1", "memory": "1Gi"}))
+        big = new_task_info(build_pod("ns1", "big", "n1", {"cpu": "2"}))
+        big.status = TaskStatus.Running
+        with pytest.raises(ValueError):
+            ni.add_task(big)
+        assert not ni.ready()
+
+    def test_duplicate_add_rejected(self):
+        ni = NodeInfo(build_node("n1", {"cpu": "4", "memory": "8Gi"}))
+        task = new_task_info(build_pod("ns1", "p1", "n1", {"cpu": "1"}))
+        ni.add_task(task)
+        with pytest.raises(ValueError):
+            ni.add_task(task)
+
+
+def test_namespace_collection_weight():
+    col = NamespaceCollection("ns1")
+    assert col.snapshot().get_weight() == 1
+    col.update("quota-a", 5)
+    col.update("quota-b", 3)
+    assert col.snapshot().get_weight() == 5
+    col.delete("quota-a")
+    assert col.snapshot().get_weight() == 3

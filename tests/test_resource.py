@@ -1,0 +1,110 @@
+"""Resource arithmetic tests.
+
+Mirrors the reference's table-driven resource tests
+(pkg/scheduler/api/resource_info_test.go).
+"""
+
+import pytest
+
+from volcano_tpu.api.resource import (
+    MIN_MEMORY,
+    MIN_MILLI_CPU,
+    Resource,
+    min_resource,
+    share,
+)
+
+
+def res(cpu=0.0, mem=0.0, **scalars):
+    return Resource(cpu, mem, scalars or None)
+
+
+class TestConstruction:
+    def test_from_resource_list_units(self):
+        r = Resource.from_resource_list(
+            {"cpu": "2", "memory": "4Gi", "pods": 110, "nvidia.com/gpu": 1}
+        )
+        assert r.milli_cpu == 2000
+        assert r.memory == 4 * 1024**3
+        assert r.max_task_num == 110
+        assert r.scalars["nvidia.com/gpu"] == 1000
+
+    def test_from_resource_list_milli_cpu(self):
+        assert Resource.from_resource_list({"cpu": "250m"}).milli_cpu == 250
+
+    def test_clone_is_deep(self):
+        r = res(1000, 2**30, **{"nvidia.com/gpu": 2000})
+        c = r.clone()
+        c.scalars["nvidia.com/gpu"] = 0
+        assert r.scalars["nvidia.com/gpu"] == 2000
+
+
+class TestPredicates:
+    def test_is_empty_tolerance(self):
+        assert res(MIN_MILLI_CPU - 1, MIN_MEMORY - 1).is_empty()
+        assert not res(MIN_MILLI_CPU, 0).is_empty()
+        assert not res(0, MIN_MEMORY).is_empty()
+        assert not res(0, 0, **{"nvidia.com/gpu": 10}).is_empty()
+
+    def test_is_zero(self):
+        r = res(5, 0)
+        assert r.is_zero("cpu")
+        assert r.is_zero("memory")
+        assert r.is_zero("nvidia.com/gpu")
+
+
+class TestArithmetic:
+    def test_add_sub(self):
+        a = res(1000, 1024, **{"nvidia.com/gpu": 1000})
+        b = res(500, 512, **{"nvidia.com/gpu": 500})
+        a.add(b)
+        assert (a.milli_cpu, a.memory, a.scalars["nvidia.com/gpu"]) == (1500, 1536, 1500)
+        a.sub(b)
+        assert (a.milli_cpu, a.memory, a.scalars["nvidia.com/gpu"]) == (1000, 1024, 1000)
+
+    def test_sub_insufficient_asserts(self):
+        with pytest.raises(AssertionError):
+            res(100).sub(res(500))
+
+    def test_multi(self):
+        r = res(1000, 1000, **{"x": 10}).multi(1.5)
+        assert (r.milli_cpu, r.memory, r.scalars["x"]) == (1500, 1500, 15)
+
+    def test_set_max(self):
+        r = res(100, 5000).set_max(res(500, 1000, **{"x": 7}))
+        assert (r.milli_cpu, r.memory, r.scalars["x"]) == (500, 5000, 7)
+
+    def test_diff(self):
+        inc, dec = res(1000, 100).diff(res(400, 300))
+        assert (inc.milli_cpu, inc.memory) == (600, 0)
+        assert (dec.milli_cpu, dec.memory) == (0, 200)
+
+
+class TestComparisons:
+    def test_less_equal_within_tolerance(self):
+        # Equal-within-tolerance counts as LessEqual (resource_info.go:292).
+        assert res(1000 + MIN_MILLI_CPU - 1, 0).less_equal(res(1000, 0))
+        assert not res(1000 + MIN_MILLI_CPU, 0).less_equal(res(1000, 0))
+
+    def test_less_equal_ignores_negligible_scalars(self):
+        assert res(100, 0, **{"x": 5}).less_equal(res(100, 0))
+        assert not res(100, 0, **{"x": 500}).less_equal(res(100, 0))
+
+    def test_less_strict_all_dims(self):
+        assert res(1, 1).less(res(2, 2))
+        assert not res(1, 2).less(res(2, 2))
+
+    def test_less_equal_strict(self):
+        assert res(2, 2).less_equal_strict(res(2, 2))
+        assert not res(3, 2).less_equal_strict(res(2, 2))
+
+
+def test_min_resource():
+    m = min_resource(res(100, 500, **{"x": 5}), res(200, 300, **{"x": 9}))
+    assert (m.milli_cpu, m.memory, m.scalars["x"]) == (100, 300, 5)
+
+
+def test_share_conventions():
+    assert share(0, 0) == 0
+    assert share(5, 0) == 1
+    assert share(1, 4) == 0.25

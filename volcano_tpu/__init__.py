@@ -1,0 +1,36 @@
+"""volcano_tpu — a TPU-native batch-scheduling framework.
+
+A ground-up rebuild of the capabilities of Volcano (kube-batch): gang
+scheduling, multi-queue weighted fair share, DRF, priority /
+preemption / reclaim / backfill, a Job CRD with a lifecycle state
+machine and distributed-job plugins, admission webhooks, and a CLI.
+
+The control plane is an event-driven host layer (see
+``volcano_tpu.controllers``, ``volcano_tpu.scheduler``); the per-session
+O(tasks × nodes) predicate/score/assign hot path is packed into device
+tensors and executed as JAX/XLA kernels on TPU (``volcano_tpu.ops``),
+sharded over a device mesh for large sessions (``volcano_tpu.parallel``).
+
+Layer map (mirrors the reference architecture, re-designed TPU-first):
+
+- ``volcano_tpu.apis``       — self-contained Kubernetes-style object model
+                               (reference: pkg/apis + core k8s types).
+- ``volcano_tpu.client``     — in-memory API server, informers, listers
+                               (reference: pkg/client).
+- ``volcano_tpu.api``        — the scheduler's internal pure data model
+                               (reference: pkg/scheduler/api).
+- ``volcano_tpu.ops``        — device kernels: snapshot packing, predicate
+                               masks, scoring, greedy gang assignment.
+- ``volcano_tpu.parallel``   — mesh/sharding for multi-chip sessions.
+- ``volcano_tpu.framework``  — session, statement, plugin/action registries
+                               (reference: pkg/scheduler/framework).
+- ``volcano_tpu.actions``    — enqueue/allocate/backfill/preempt/reclaim.
+- ``volcano_tpu.plugins``    — gang/drf/proportion/priority/predicates/
+                               nodeorder/binpack/conformance/task-topology.
+- ``volcano_tpu.scheduler``  — cache, session loop, metrics.
+- ``volcano_tpu.controllers``— job/queue/podgroup/gc controllers.
+- ``volcano_tpu.admission``  — validating/mutating webhook handlers.
+- ``volcano_tpu.cli``        — ``vtctl``.
+"""
+
+__version__ = "0.1.0"

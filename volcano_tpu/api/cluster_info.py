@@ -1,0 +1,26 @@
+"""ClusterInfo — the immutable snapshot a session computes on.
+
+Reference: pkg/scheduler/api/cluster_info.go.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from volcano_tpu.api.job_info import JobInfo
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.queue_info import NamespaceInfo, QueueInfo
+
+
+class ClusterInfo:
+    def __init__(self):
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+        self.namespace_info: Dict[str, NamespaceInfo] = {}
+
+    def __repr__(self) -> str:
+        return (
+            f"Cluster: {len(self.jobs)} jobs, {len(self.nodes)} nodes, "
+            f"{len(self.queues)} queues"
+        )

@@ -1,0 +1,144 @@
+"""NodeInfo — per-session resource accounting for one node.
+
+Reference: pkg/scheduler/api/node_info.go.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from volcano_tpu.api.resource import Resource, empty_resource
+from volcano_tpu.api.types import NodePhase, TaskStatus
+from volcano_tpu.api.job_info import TaskInfo
+from volcano_tpu.apis import core
+
+
+class NodeInfo:
+    """Idle/Used/Releasing/Pipelined accounting (node_info.go:27-58)."""
+
+    def __init__(self, node: Optional[core.Node] = None):
+        self.node = node
+        self.name = node.metadata.name if node else ""
+        self.releasing = empty_resource()
+        self.pipelined = empty_resource()
+        self.used = empty_resource()
+        self.tasks: Dict[str, TaskInfo] = {}
+        self.others: Dict[str, object] = {}
+        if node is not None:
+            self.idle = Resource.from_resource_list(node.status.allocatable)
+            self.allocatable = Resource.from_resource_list(node.status.allocatable)
+            self.capability = Resource.from_resource_list(node.status.capacity)
+        else:
+            self.idle = empty_resource()
+            self.allocatable = empty_resource()
+            self.capability = empty_resource()
+        self.phase = NodePhase.NotReady
+        self.reason = "UnInitialized"
+        self._set_node_state(node)
+
+    # ---- state ----
+
+    def _set_node_state(self, node: Optional[core.Node]) -> None:
+        if node is None:
+            self.phase, self.reason = NodePhase.NotReady, "UnInitialized"
+            return
+        if not self.used.less_equal(Resource.from_resource_list(node.status.allocatable)):
+            self.phase, self.reason = NodePhase.NotReady, "OutOfSync"
+            return
+        for cond in node.status.conditions:
+            if cond.type == "Ready" and cond.status != "True":
+                self.phase, self.reason = NodePhase.NotReady, "NotReady"
+                return
+        self.phase, self.reason = NodePhase.Ready, ""
+
+    def ready(self) -> bool:
+        return self.phase == NodePhase.Ready
+
+    def set_node(self, node: core.Node) -> None:
+        """Refresh from the API object, re-deriving Idle/Used from held tasks
+        (node_info.go:158-190)."""
+        self._set_node_state(node)
+        if not self.ready():
+            return
+        self.node = node
+        self.name = node.metadata.name
+        self.allocatable = Resource.from_resource_list(node.status.allocatable)
+        self.capability = Resource.from_resource_list(node.status.capacity)
+        self.releasing = empty_resource()
+        self.pipelined = empty_resource()
+        self.idle = Resource.from_resource_list(node.status.allocatable)
+        self.used = empty_resource()
+        for task in self.tasks.values():
+            if task.status == TaskStatus.Releasing:
+                self.idle.sub(task.resreq)
+                self.releasing.add(task.resreq)
+                self.used.add(task.resreq)
+            elif task.status == TaskStatus.Pipelined:
+                self.pipelined.add(task.resreq)
+            else:
+                self.idle.sub(task.resreq)
+                self.used.add(task.resreq)
+
+    def future_idle(self) -> Resource:
+        """Idle + Releasing − Pipelined (node_info.go:56-58)."""
+        return self.idle.clone().add(self.releasing).sub_unchecked(self.pipelined)
+
+    # ---- task accounting (node_info.go:205-275) ----
+
+    def _allocate_idle(self, task: TaskInfo) -> None:
+        if not task.resreq.less_equal(self.idle):
+            self.phase, self.reason = NodePhase.NotReady, "OutOfSync"
+            raise ValueError(f"Selected node {self.name} NotReady")
+        self.idle.sub(task.resreq)
+
+    def add_task(self, task: TaskInfo) -> None:
+        key = task.uid
+        if key in self.tasks:
+            raise ValueError(f"task {task.namespace}/{task.name} already on node {self.name}")
+        # Hold a copy so later status changes don't skew accounting.
+        ti = task.clone()
+        if self.node is not None:
+            if ti.status == TaskStatus.Releasing:
+                self._allocate_idle(ti)
+                self.releasing.add(ti.resreq)
+                self.used.add(ti.resreq)
+            elif ti.status == TaskStatus.Pipelined:
+                self.pipelined.add(ti.resreq)
+            else:
+                self._allocate_idle(ti)
+                self.used.add(ti.resreq)
+        self.tasks[key] = ti
+
+    def remove_task(self, task: TaskInfo) -> None:
+        stored = self.tasks.get(task.uid)
+        if stored is None:
+            raise KeyError(f"task {task.namespace}/{task.name} not on node {self.name}")
+        if self.node is not None:
+            if stored.status == TaskStatus.Releasing:
+                self.releasing.sub_unchecked(stored.resreq)
+                self.idle.add(stored.resreq)
+                self.used.sub_unchecked(stored.resreq)
+            elif stored.status == TaskStatus.Pipelined:
+                self.pipelined.sub_unchecked(stored.resreq)
+            else:
+                self.idle.add(stored.resreq)
+                self.used.sub_unchecked(stored.resreq)
+        del self.tasks[task.uid]
+
+    def update_task(self, task: TaskInfo) -> None:
+        self.remove_task(task)
+        self.add_task(task)
+
+    def clone(self) -> "NodeInfo":
+        res = NodeInfo(self.node)
+        for task in self.tasks.values():
+            res.add_task(task)
+        res.others = self.others
+        return res
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        return self.node.metadata.labels if self.node else {}
+
+    def __repr__(self) -> str:
+        return f"Node ({self.name}): idle <{self.idle}>, used <{self.used}>"

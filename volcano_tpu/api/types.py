@@ -1,0 +1,49 @@
+"""Task/node status enums and callback result types.
+
+Reference: pkg/scheduler/api/types.go.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class TaskStatus(enum.IntFlag):
+    """Status of a task/pod in the scheduler (types.go:26-58)."""
+
+    Pending = enum.auto()
+    Allocated = enum.auto()
+    Pipelined = enum.auto()
+    Binding = enum.auto()
+    Bound = enum.auto()
+    Running = enum.auto()
+    Releasing = enum.auto()
+    Succeeded = enum.auto()
+    Failed = enum.auto()
+    Unknown = enum.auto()
+
+
+#: Statuses whose resources are held on a node ("occupied").
+#: Reference: types.go AllocatedStatus (Bound/Binding/Running/Allocated).
+_ALLOCATED = (
+    TaskStatus.Bound | TaskStatus.Binding | TaskStatus.Running | TaskStatus.Allocated
+)
+
+
+def allocated_status(status: TaskStatus) -> bool:
+    return bool(status & _ALLOCATED)
+
+
+class NodePhase(enum.IntEnum):
+    Ready = 1
+    NotReady = 2
+
+
+@dataclass
+class ValidateResult:
+    """Result of a JobValid callback (types.go ValidateResult)."""
+
+    pass_: bool = True
+    reason: str = ""
+    message: str = ""

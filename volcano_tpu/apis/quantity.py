@@ -1,0 +1,62 @@
+"""Kubernetes resource-quantity parsing.
+
+Mirrors the subset of k8s.io/apimachinery resource.Quantity semantics the
+reference scheduler relies on (reference: pkg/scheduler/api/resource_info.go
+NewResource — MilliValue for cpu/scalars, Value for memory/pods).
+"""
+
+from __future__ import annotations
+
+import math
+
+_BINARY_SUFFIXES = {
+    "Ki": 1024,
+    "Mi": 1024**2,
+    "Gi": 1024**3,
+    "Ti": 1024**4,
+    "Pi": 1024**5,
+    "Ei": 1024**6,
+}
+_DECIMAL_SUFFIXES = {
+    "n": 1e-9,
+    "u": 1e-6,
+    "m": 1e-3,
+    "k": 1e3,
+    "M": 1e6,
+    "G": 1e9,
+    "T": 1e12,
+    "P": 1e15,
+    "E": 1e18,
+}
+
+
+def parse_quantity(value) -> float:
+    """Parse a k8s quantity ("100m", "1Gi", 2, "1.5") to a float base value."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = str(value).strip()
+    if not s:
+        return 0.0
+    for suffix, mult in _BINARY_SUFFIXES.items():
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * mult
+    if s[-1] in _DECIMAL_SUFFIXES and not s[-1].isdigit():
+        return float(s[:-1]) * _DECIMAL_SUFFIXES[s[-1]]
+    return float(s)
+
+
+def milli_value(value) -> float:
+    """Quantity → milli units, rounded up (resource.Quantity.MilliValue)."""
+    return float(math.ceil(parse_quantity(value) * 1000))
+
+
+def int_value(value) -> float:
+    """Quantity → integer base value, rounded up (resource.Quantity.Value)."""
+    return float(math.ceil(parse_quantity(value)))
+
+
+def format_quantity(value: float) -> str:
+    """Best-effort human formatting for ints/floats (used by CLI output)."""
+    if value == int(value):
+        return str(int(value))
+    return f"{value:g}"

@@ -1,0 +1,85 @@
+"""Generic dataclass ↔ dict (de)serialization with k8s-style camelCase keys.
+
+All API objects round-trip through plain dicts so the CLI can read/write YAML
+and the in-memory API server can deep-copy objects cheaply.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import re
+import typing
+
+_CAMEL_RE = re.compile(r"(?<!^)(?=[A-Z])")
+
+
+def snake(name: str) -> str:
+    return _CAMEL_RE.sub("_", name).lower()
+
+
+def camel(name: str) -> str:
+    head, *tail = name.split("_")
+    return head + "".join(p.capitalize() for p in tail)
+
+
+def _unwrap_optional(tp):
+    if typing.get_origin(tp) is typing.Union:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def _from_value(tp, value):
+    tp = _unwrap_optional(tp)
+    origin = typing.get_origin(tp)
+    if value is None:
+        return None
+    if dataclasses.is_dataclass(tp):
+        return from_dict(tp, value)
+    if origin in (list, typing.List):
+        (elem,) = typing.get_args(tp)
+        return [_from_value(elem, v) for v in value]
+    if origin in (dict, typing.Dict):
+        _, val_t = typing.get_args(tp)
+        return {k: _from_value(val_t, v) for k, v in value.items()}
+    return copy.deepcopy(value)
+
+
+def from_dict(cls, data):
+    """Build dataclass ``cls`` from a dict with camelCase or snake_case keys."""
+    if data is None:
+        return None
+    if dataclasses.is_dataclass(data.__class__):
+        return copy.deepcopy(data)
+    hints = typing.get_type_hints(cls)
+    names = {f.name for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for key, value in data.items():
+        name = key if key in names else snake(key)
+        if name not in names:
+            continue
+        kwargs[name] = _from_value(hints[name], value)
+    return cls(**kwargs)
+
+
+def _to_value(value, drop_empty: bool):
+    if dataclasses.is_dataclass(value.__class__) and not isinstance(value, type):
+        return to_dict(value, drop_empty=drop_empty)
+    if isinstance(value, list):
+        return [_to_value(v, drop_empty) for v in value]
+    if isinstance(value, dict):
+        return {k: _to_value(v, drop_empty) for k, v in value.items()}
+    return copy.deepcopy(value)
+
+
+def to_dict(obj, drop_empty: bool = True) -> dict:
+    """Dataclass → dict with camelCase keys; empty/None fields dropped."""
+    out = {}
+    for f in dataclasses.fields(obj):
+        value = getattr(obj, f.name)
+        if drop_empty and (value is None or value == [] or value == {}):
+            continue
+        out[camel(f.name)] = _to_value(value, drop_empty)
+    return out

@@ -1,0 +1,5 @@
+"""Host-side utilities: priority queue, logging, assertions."""
+
+from volcano_tpu.utils.priority_queue import PriorityQueue
+
+__all__ = ["PriorityQueue"]
