@@ -1,0 +1,58 @@
+"""Fake side-effect executors for scheduler tests.
+
+Mirrors pkg/scheduler/util/test_utils.go FakeBinder/FakeEvictor/
+FakeStatusUpdater: binds/evictions land in in-memory lists the tests
+assert on (the Go versions push to channels).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from volcano_tpu.api import TaskInfo
+from volcano_tpu.apis import scheduling
+from volcano_tpu.cache.interface import Binder, Evictor, StatusUpdater
+
+
+class FakeBinder(Binder):
+    """test_utils.go:94-110."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.binds: Dict[str, str] = {}
+
+    def bind(self, task: TaskInfo, hostname: str) -> None:
+        with self.lock:
+            self.binds[f"{task.namespace}/{task.name}"] = hostname
+
+    @property
+    def length(self) -> int:
+        return len(self.binds)
+
+
+class FakeEvictor(Evictor):
+    """test_utils.go:117-140."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.evicts: List[str] = []
+
+    def evict(self, task: TaskInfo) -> None:
+        with self.lock:
+            self.evicts.append(f"{task.namespace}/{task.name}")
+
+
+class FakeStatusUpdater(StatusUpdater):
+    """test_utils.go:147-159 — does nothing, like the reference fake."""
+
+    def __init__(self):
+        self.pod_conditions: List[tuple] = []
+        self.pod_groups: List[scheduling.PodGroup] = []
+
+    def update_pod_condition(self, task: TaskInfo, reason: str, message: str) -> None:
+        self.pod_conditions.append((f"{task.namespace}/{task.name}", reason, message))
+
+    def update_pod_group(self, pg: scheduling.PodGroup) -> Optional[scheduling.PodGroup]:
+        self.pod_groups.append(pg)
+        return pg

@@ -1,0 +1,62 @@
+"""Shared scaffolding for scheduler action/plugin tests.
+
+Mirrors the reference's unit-test pattern (allocate_test.go:155-222):
+build a real SchedulerCache without informers by calling event handlers
+directly, inject fakes for side effects, open a real session with explicit
+tiers, run the real action, assert on the binds the fake binder received.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import volcano_tpu.actions  # noqa: F401 — registers actions
+import volcano_tpu.plugins  # noqa: F401 — registers plugin builders
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.conf import PluginOption, Tier
+from volcano_tpu.framework import open_session, close_session
+
+from tests.fakes import FakeBinder, FakeEvictor, FakeStatusUpdater
+
+
+def make_cache(
+    nodes=(),
+    pods=(),
+    pod_groups=(),
+    queues=(),
+    priority_classes=(),
+) -> SchedulerCache:
+    cache = SchedulerCache(
+        binder=FakeBinder(),
+        evictor=FakeEvictor(),
+        status_updater=FakeStatusUpdater(),
+    )
+    for node in nodes:
+        cache.add_node(node)
+    for pod in pods:
+        cache.add_pod(pod)
+    for pg in pod_groups:
+        cache.add_pod_group(pg)
+    for q in queues:
+        cache.add_queue(q)
+    for pc in priority_classes:
+        cache.add_priority_class(pc)
+    return cache
+
+
+def tiers(*plugin_name_groups: List[str]) -> List[Tier]:
+    return [
+        Tier(plugins=[PluginOption(name=n) for n in group])
+        for group in plugin_name_groups
+    ]
+
+
+def run_actions(cache: SchedulerCache, actions, tier_conf, configurations=None):
+    """Open a session, run the actions, close it; return the session."""
+    ssn = open_session(cache, tier_conf, configurations or [])
+    try:
+        for action in actions:
+            action.execute(ssn)
+    finally:
+        close_session(ssn)
+    return ssn

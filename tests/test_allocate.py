@@ -1,0 +1,150 @@
+"""Allocate action tests — reference cases from
+pkg/scheduler/actions/allocate/allocate_test.go plus gang semantics."""
+
+from __future__ import annotations
+
+from volcano_tpu.actions.allocate import AllocateAction
+
+from tests.builders import build_node, build_pod, build_pod_group, build_queue
+from tests.scheduler_helpers import make_cache, run_actions, tiers
+
+
+def test_one_job_two_pods_on_one_node():
+    """allocate_test.go 'one Job with two Pods on one node'."""
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "2", "memory": "4Gi"})],
+        pods=[
+            build_pod("c1", "p1", "", {"cpu": "1", "memory": "1G"}, group="pg1"),
+            build_pod("c1", "p2", "", {"cpu": "1", "memory": "1G"}, group="pg1"),
+        ],
+        pod_groups=[build_pod_group("c1", "pg1", 0, queue="c1")],
+        queues=[build_queue("c1", weight=1)],
+    )
+    run_actions(cache, [AllocateAction()], tiers(["drf", "proportion"]))
+    assert cache.binder.binds == {"c1/p1": "n1", "c1/p2": "n1"}
+
+
+def test_two_jobs_on_one_node_namespace_balanced():
+    """allocate_test.go 'two Jobs on one node' — DRF namespace balancing
+    gives one pod to each namespace when only two fit."""
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "2", "memory": "4G"})],
+        pods=[
+            build_pod("c1", "p1", "", {"cpu": "1", "memory": "1G"}, group="pg1"),
+            build_pod("c1", "p2", "", {"cpu": "1", "memory": "1G"}, group="pg1"),
+            build_pod("c2", "p1", "", {"cpu": "1", "memory": "1G"}, group="pg2"),
+            build_pod("c2", "p2", "", {"cpu": "1", "memory": "1G"}, group="pg2"),
+        ],
+        pod_groups=[
+            build_pod_group("c1", "pg1", 0, queue="c1"),
+            build_pod_group("c2", "pg2", 0, queue="c2"),
+        ],
+        queues=[build_queue("c1", weight=1), build_queue("c2", weight=1)],
+    )
+    run_actions(cache, [AllocateAction()], tiers(["drf", "proportion"]))
+    assert cache.binder.binds == {"c1/p1": "n1", "c2/p1": "n1"}
+
+
+def test_gang_all_or_nothing_discards_partial():
+    """A gang job whose minMember cannot be satisfied binds nothing."""
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "1", "memory": "2G"})],
+        pods=[
+            build_pod("c1", "p1", "", {"cpu": "1", "memory": "1G"}, group="pg1"),
+            build_pod("c1", "p2", "", {"cpu": "1", "memory": "1G"}, group="pg1"),
+        ],
+        pod_groups=[build_pod_group("c1", "pg1", 2, queue="c1")],
+        queues=[build_queue("c1")],
+    )
+    run_actions(
+        cache, [AllocateAction()], tiers(["priority", "gang"], ["drf", "proportion"])
+    )
+    assert cache.binder.binds == {}
+
+
+def test_gang_binds_all_when_min_member_fits():
+    cache = make_cache(
+        nodes=[
+            build_node("n1", {"cpu": "1", "memory": "2G"}),
+            build_node("n2", {"cpu": "1", "memory": "2G"}),
+        ],
+        pods=[
+            build_pod("c1", "p1", "", {"cpu": "1", "memory": "1G"}, group="pg1"),
+            build_pod("c1", "p2", "", {"cpu": "1", "memory": "1G"}, group="pg1"),
+        ],
+        pod_groups=[build_pod_group("c1", "pg1", 2, queue="c1")],
+        queues=[build_queue("c1")],
+    )
+    run_actions(
+        cache, [AllocateAction()], tiers(["priority", "gang"], ["drf", "proportion"])
+    )
+    assert set(cache.binder.binds) == {"c1/p1", "c1/p2"}
+    assert set(cache.binder.binds.values()) == {"n1", "n2"}
+
+
+def test_pending_pod_group_is_skipped():
+    """PodGroupPending jobs are not allocated (allocate.go:61-63)."""
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "2", "memory": "4G"})],
+        pods=[build_pod("c1", "p1", "", {"cpu": "1", "memory": "1G"}, group="pg1")],
+        pod_groups=[build_pod_group("c1", "pg1", 0, queue="c1", phase="Pending")],
+        queues=[build_queue("c1")],
+    )
+    run_actions(cache, [AllocateAction()], tiers(["drf", "proportion"]))
+    assert cache.binder.binds == {}
+
+
+def test_best_effort_tasks_skipped_by_allocate():
+    """Zero-request tasks are backfill's job, not allocate's
+    (allocate.go:158-167)."""
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "2", "memory": "4G"})],
+        pods=[build_pod("c1", "p1", "", {}, group="pg1")],
+        pod_groups=[build_pod_group("c1", "pg1", 0, queue="c1")],
+        queues=[build_queue("c1")],
+    )
+    run_actions(cache, [AllocateAction()], tiers(["drf", "proportion"]))
+    assert cache.binder.binds == {}
+
+
+def test_node_selector_predicate_filters_nodes():
+    cache = make_cache(
+        nodes=[
+            build_node("n1", {"cpu": "2", "memory": "4G"}, labels={"disk": "hdd"}),
+            build_node("n2", {"cpu": "2", "memory": "4G"}, labels={"disk": "ssd"}),
+        ],
+        pods=[
+            build_pod(
+                "c1", "p1", "", {"cpu": "1", "memory": "1G"},
+                group="pg1", selector={"disk": "ssd"},
+            )
+        ],
+        pod_groups=[build_pod_group("c1", "pg1", 0, queue="c1")],
+        queues=[build_queue("c1")],
+    )
+    run_actions(
+        cache, [AllocateAction()], tiers(["gang"], ["drf", "predicates", "proportion"])
+    )
+    assert cache.binder.binds == {"c1/p1": "n2"}
+
+
+def test_taints_respected():
+    from volcano_tpu.apis import core
+
+    cache = make_cache(
+        nodes=[
+            build_node(
+                "n1",
+                {"cpu": "2", "memory": "4G"},
+                taints=[core.Taint(key="dedicated", value="infra", effect="NoSchedule")],
+            ),
+            build_node("n2", {"cpu": "2", "memory": "4G"}),
+        ],
+        pods=[build_pod("c1", "p1", "", {"cpu": "1", "memory": "1G"}, group="pg1")],
+        pod_groups=[build_pod_group("c1", "pg1", 0, queue="c1")],
+        queues=[build_queue("c1")],
+    )
+    run_actions(
+        cache, [AllocateAction()], tiers(["gang"], ["drf", "predicates", "proportion"])
+    )
+    assert cache.binder.binds == {"c1/p1": "n2"}

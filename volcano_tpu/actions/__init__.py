@@ -1,0 +1,17 @@
+"""Action registry — mirrors the blank-import registration in
+cmd/scheduler/main.go:36-38."""
+
+from volcano_tpu.framework.interface import register_action
+
+from volcano_tpu.actions import allocate, backfill, enqueue, preempt, reclaim
+
+
+def register_all() -> None:
+    register_action(enqueue.new())
+    register_action(allocate.new())
+    register_action(backfill.new())
+    register_action(preempt.new())
+    register_action(reclaim.new())
+
+
+register_all()

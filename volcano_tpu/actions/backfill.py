@@ -1,0 +1,58 @@
+"""Backfill action — place best-effort tasks on any feasible node.
+
+Reference: pkg/scheduler/actions/backfill/backfill.go.
+"""
+
+from __future__ import annotations
+
+from volcano_tpu.api import FitError, TaskStatus
+from volcano_tpu.api.unschedule_info import FitErrors
+from volcano_tpu.apis import scheduling
+from volcano_tpu.framework.interface import Action
+from volcano_tpu.framework.session import Session
+from volcano_tpu.scheduler import util as sched_util
+
+
+class BackfillAction(Action):
+    def name(self) -> str:
+        return "backfill"
+
+    def execute(self, ssn: Session) -> None:
+        """backfill.go:41-91."""
+        for job in sorted(ssn.jobs.values(), key=lambda j: j.uid):
+            if (
+                job.pod_group is not None
+                and job.pod_group.status.phase == scheduling.POD_GROUP_PENDING
+            ):
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.pass_:
+                continue
+
+            for task in sorted(
+                job.task_status_index.get(TaskStatus.Pending, {}).values(),
+                key=lambda t: t.uid,
+            ):
+                if not task.init_resreq.is_empty():
+                    continue
+                allocated = False
+                fe = FitErrors()
+                for node in sched_util.get_node_list(ssn.nodes):
+                    try:
+                        ssn.predicate_fn(task, node)
+                    except FitError as err:
+                        fe.set_node_error(node.name, err)
+                        continue
+                    try:
+                        ssn.allocate(task, node.name)
+                    except Exception as err:  # noqa: BLE001 — try next node
+                        fe.set_node_error(node.name, FitError(task, node, str(err)))
+                        continue
+                    allocated = True
+                    break
+                if not allocated:
+                    job.nodes_fit_errors[task.uid] = fe
+
+
+def new() -> BackfillAction:
+    return BackfillAction()

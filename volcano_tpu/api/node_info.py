@@ -25,8 +25,8 @@ class NodeInfo:
         self.tasks: Dict[str, TaskInfo] = {}
         self.others: Dict[str, object] = {}
         if node is not None:
-            self.idle = Resource.from_resource_list(node.status.allocatable)
             self.allocatable = Resource.from_resource_list(node.status.allocatable)
+            self.idle = self.allocatable.clone()
             self.capability = Resource.from_resource_list(node.status.capacity)
         else:
             self.idle = empty_resource()
@@ -34,15 +34,19 @@ class NodeInfo:
             self.capability = empty_resource()
         self.phase = NodePhase.NotReady
         self.reason = "UnInitialized"
-        self._set_node_state(node)
+        self._set_node_state(node, self.allocatable)
 
     # ---- state ----
 
-    def _set_node_state(self, node: Optional[core.Node]) -> None:
+    def _set_node_state(
+        self, node: Optional[core.Node], allocatable: Optional[Resource] = None
+    ) -> None:
         if node is None:
             self.phase, self.reason = NodePhase.NotReady, "UnInitialized"
             return
-        if not self.used.less_equal(Resource.from_resource_list(node.status.allocatable)):
+        if allocatable is None:
+            allocatable = Resource.from_resource_list(node.status.allocatable)
+        if not self.used.less_equal(allocatable):
             self.phase, self.reason = NodePhase.NotReady, "OutOfSync"
             return
         for cond in node.status.conditions:
@@ -57,16 +61,17 @@ class NodeInfo:
     def set_node(self, node: core.Node) -> None:
         """Refresh from the API object, re-deriving Idle/Used from held tasks
         (node_info.go:158-190)."""
-        self._set_node_state(node)
+        allocatable = Resource.from_resource_list(node.status.allocatable)
+        self._set_node_state(node, allocatable)
         if not self.ready():
             return
         self.node = node
         self.name = node.metadata.name
-        self.allocatable = Resource.from_resource_list(node.status.allocatable)
+        self.allocatable = allocatable
         self.capability = Resource.from_resource_list(node.status.capacity)
         self.releasing = empty_resource()
         self.pipelined = empty_resource()
-        self.idle = Resource.from_resource_list(node.status.allocatable)
+        self.idle = allocatable.clone()
         self.used = empty_resource()
         for task in self.tasks.values():
             if task.status == TaskStatus.Releasing:

@@ -1,0 +1,18 @@
+from volcano_tpu.cache.interface import Binder, Cache, Evictor, StatusUpdater
+from volcano_tpu.cache.cache import (
+    DefaultBinder,
+    DefaultEvictor,
+    DefaultStatusUpdater,
+    SchedulerCache,
+)
+
+__all__ = [
+    "Binder",
+    "Cache",
+    "Evictor",
+    "StatusUpdater",
+    "DefaultBinder",
+    "DefaultEvictor",
+    "DefaultStatusUpdater",
+    "SchedulerCache",
+]

@@ -1,0 +1,404 @@
+"""SchedulerCache — mutex-guarded mirror of cluster state.
+
+Reference: pkg/scheduler/cache/cache.go + event_handlers.go.  Fed by event
+handlers (wired to informers in production, called directly in tests —
+the reference's own unit-test pattern, allocate_test.go:155-222); produces
+deep-copied snapshots; executes bind/evict side effects asynchronously with
+an errTasks resync queue.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from volcano_tpu.api import (
+    ClusterInfo,
+    JobInfo,
+    NodeInfo,
+    QueueInfo,
+    TaskInfo,
+    TaskStatus,
+    new_task_info,
+)
+from volcano_tpu.api.job_info import get_job_id
+from volcano_tpu.api.queue_info import NamespaceCollection
+from volcano_tpu.apis import core, scheduling
+from volcano_tpu.cache.interface import Binder, Cache, Evictor, StatusUpdater
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def is_terminated(status: TaskStatus) -> bool:
+    return status in (TaskStatus.Succeeded, TaskStatus.Failed)
+
+
+class DefaultBinder(Binder):
+    """POSTs the pod binding through the API client (cache.go:122-134)."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def bind(self, task: TaskInfo, hostname: str) -> None:
+        self.client.bind_pod(task.namespace, task.name, hostname)
+
+
+class DefaultEvictor(Evictor):
+    """Deletes the pod (cache.go:141-149)."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def evict(self, task: TaskInfo) -> None:
+        self.client.delete_pod(task.namespace, task.name)
+
+
+class DefaultStatusUpdater(StatusUpdater):
+    """cache.go defaultStatusUpdater."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def update_pod_condition(self, task: TaskInfo, reason: str, message: str) -> None:
+        self.client.update_pod_condition(task.namespace, task.name, reason, message)
+
+    def update_pod_group(self, pg: scheduling.PodGroup):
+        return self.client.update_pod_group(pg)
+
+
+class SchedulerCache(Cache):
+    def __init__(
+        self,
+        binder: Optional[Binder] = None,
+        evictor: Optional[Evictor] = None,
+        status_updater: Optional[StatusUpdater] = None,
+        scheduler_name: str = "volcano",
+        default_queue: str = "default",
+        default_priority: int = 0,
+        sync_side_effects: bool = True,
+        client=None,
+    ):
+        self._mutex = threading.RLock()
+        self.scheduler_name = scheduler_name
+        self.default_queue = default_queue
+        self.default_priority = default_priority
+
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+        self.priority_classes: Dict[str, core.PriorityClass] = {}
+        self.namespace_collections: Dict[str, NamespaceCollection] = {}
+
+        self.client = client
+        self.binder = binder or (DefaultBinder(client) if client else None)
+        self.evictor = evictor or (DefaultEvictor(client) if client else None)
+        self.status_updater = status_updater or (
+            DefaultStatusUpdater(client) if client else None
+        )
+
+        #: tasks whose async side effects failed; re-synced from API truth
+        #: (cache.go:687-709 errTasks workqueue).
+        self.err_tasks: List[TaskInfo] = []
+
+        # The reference fires bind/evict in goroutines (cache.go:596-612).
+        # sync_side_effects=True (default) keeps them on-thread for
+        # deterministic tests and simpler failure semantics.
+        self._sync = sync_side_effects
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pending: List[Future] = []
+
+    # ---- lifecycle ----
+
+    def run(self) -> None:
+        if not self._sync and self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=8)
+        if self.client is not None:
+            self.client.watch(self)
+
+    def wait_for_cache_sync(self) -> bool:
+        return True
+
+    def flush(self) -> None:
+        """Wait for async side effects (test/shutdown aid)."""
+        for f in list(self._pending):
+            f.result()
+        self._pending.clear()
+
+    def _run_effect(self, fn, *args) -> None:
+        if self._sync or self._pool is None:
+            fn(*args)
+        else:
+            self._pending.append(self._pool.submit(fn, *args))
+
+    # ---- event handlers: pods (event_handlers.go:39-254) ----
+
+    def _get_or_create_job(self, ti: TaskInfo) -> Optional[JobInfo]:
+        """event_handlers.go:44-58 — only pods carrying a PodGroup
+        annotation get a job; others are node-accounting-only."""
+        if not ti.job:
+            return None
+        if ti.job not in self.jobs:
+            self.jobs[ti.job] = JobInfo(ti.job)
+        return self.jobs[ti.job]
+
+    def _add_task(self, ti: TaskInfo) -> None:
+        """event_handlers.go:60-79."""
+        job = self._get_or_create_job(ti)
+        if job is not None:
+            job.add_task_info(ti)
+        if ti.node_name:
+            if ti.node_name not in self.nodes:
+                self.nodes[ti.node_name] = NodeInfo(None)
+                self.nodes[ti.node_name].name = ti.node_name
+            if not is_terminated(ti.status):
+                self.nodes[ti.node_name].add_task(ti)
+
+    def _delete_task(self, ti: TaskInfo) -> None:
+        """event_handlers.go:126-151."""
+        if ti.job and ti.job in self.jobs:
+            job = self.jobs[ti.job]
+            stored = job.tasks.get(ti.uid)
+            if stored is not None:
+                job.delete_task_info(stored)
+        if ti.node_name and ti.node_name in self.nodes:
+            node = self.nodes[ti.node_name]
+            if ti.uid in node.tasks:
+                node.remove_task(ti)
+
+    def add_pod(self, pod: core.Pod) -> None:
+        with self._mutex:
+            self._add_task(new_task_info(pod))
+
+    def update_pod(self, old_pod: core.Pod, new_pod: core.Pod) -> None:
+        with self._mutex:
+            self._delete_task(new_task_info(old_pod))
+            self._add_task(new_task_info(new_pod))
+
+    def delete_pod(self, pod: core.Pod) -> None:
+        with self._mutex:
+            self._delete_task(new_task_info(pod))
+
+    # ---- event handlers: nodes (event_handlers.go:255-354) ----
+
+    def add_node(self, node: core.Node) -> None:
+        with self._mutex:
+            name = node.metadata.name
+            if name in self.nodes:
+                self.nodes[name].set_node(node)
+            else:
+                self.nodes[name] = NodeInfo(node)
+
+    def update_node(self, old_node: core.Node, new_node: core.Node) -> None:
+        with self._mutex:
+            name = new_node.metadata.name
+            if name in self.nodes:
+                self.nodes[name].set_node(new_node)
+            else:
+                self.nodes[name] = NodeInfo(new_node)
+
+    def delete_node(self, node: core.Node) -> None:
+        with self._mutex:
+            self.nodes.pop(node.metadata.name, None)
+
+    # ---- event handlers: podgroups (event_handlers.go:356-581) ----
+
+    def add_pod_group(self, pg: scheduling.PodGroup) -> None:
+        with self._mutex:
+            job_id = pg.key()
+            if job_id not in self.jobs:
+                self.jobs[job_id] = JobInfo(job_id)
+            self.jobs[job_id].set_pod_group(pg)
+
+    def update_pod_group(self, old_pg, new_pg: scheduling.PodGroup) -> None:
+        self.add_pod_group(new_pg)
+
+    def delete_pod_group(self, pg: scheduling.PodGroup) -> None:
+        with self._mutex:
+            job = self.jobs.get(pg.key())
+            if job is not None:
+                job.pod_group = None
+                # Jobs without scheduling spec drop out of snapshots; GC'd
+                # when tasks drain (cleanup worker in the reference).
+                if not job.tasks:
+                    del self.jobs[pg.key()]
+
+    # ---- event handlers: queues (event_handlers.go:696-863) ----
+
+    def add_queue(self, queue: scheduling.Queue) -> None:
+        with self._mutex:
+            qi = QueueInfo(queue)
+            self.queues[qi.uid] = qi
+
+    def update_queue(self, old_queue, new_queue: scheduling.Queue) -> None:
+        self.add_queue(new_queue)
+
+    def delete_queue(self, queue: scheduling.Queue) -> None:
+        with self._mutex:
+            self.queues.pop(queue.metadata.name, None)
+
+    # ---- event handlers: priority classes (event_handlers.go:865-958) ----
+
+    def add_priority_class(self, pc: core.PriorityClass) -> None:
+        with self._mutex:
+            self.priority_classes[pc.metadata.name] = pc
+            if pc.global_default:
+                self.default_priority = pc.value
+
+    def delete_priority_class(self, pc: core.PriorityClass) -> None:
+        with self._mutex:
+            self.priority_classes.pop(pc.metadata.name, None)
+            if pc.global_default:
+                self.default_priority = 0
+
+    # ---- event handlers: resource quotas (event_handlers.go:961-1036) ----
+
+    def add_resource_quota(self, namespace: str, quota_name: str, weight: Optional[int]) -> None:
+        with self._mutex:
+            coll = self.namespace_collections.setdefault(
+                namespace, NamespaceCollection(namespace)
+            )
+            coll.update(quota_name, weight)
+
+    def delete_resource_quota(self, namespace: str, quota_name: str) -> None:
+        with self._mutex:
+            coll = self.namespace_collections.get(namespace)
+            if coll is not None:
+                coll.delete(quota_name)
+
+    # ---- snapshot (cache.go:712-790) ----
+
+    def snapshot(self) -> ClusterInfo:
+        with self._mutex:
+            snapshot = ClusterInfo()
+
+            for node in self.nodes.values():
+                if not node.ready():
+                    continue
+                snapshot.nodes[node.name] = node.clone()
+
+            for queue in self.queues.values():
+                snapshot.queues[queue.uid] = queue.clone()
+
+            for name, coll in self.namespace_collections.items():
+                snapshot.namespace_info[name] = coll.snapshot()
+
+            for job in self.jobs.values():
+                # No scheduling spec → not schedulable (cache.go:765-770).
+                if job.pod_group is None:
+                    continue
+                if job.queue not in snapshot.queues:
+                    continue
+                job.priority = self.default_priority
+                pri_name = job.pod_group.spec.priority_class_name
+                pc = self.priority_classes.get(pri_name)
+                if pc is not None:
+                    job.priority = pc.value
+                snapshot.jobs[job.uid] = job.clone()
+                snapshot.jobs[job.uid].priority = job.priority
+
+            return snapshot
+
+    # ---- side effects (cache.go:498-615) ----
+
+    def _find_job_and_task(self, task_info: TaskInfo):
+        job = self.jobs.get(task_info.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task_info.job}")
+        task = job.tasks.get(task_info.uid)
+        if task is None:
+            raise KeyError(
+                f"failed to find task in status {task_info.status.name} by id {task_info.uid}"
+            )
+        return job, task
+
+    def bind(self, task_info: TaskInfo, hostname: str) -> None:
+        """cache.go:557-615."""
+        with self._mutex:
+            job, task = self._find_job_and_task(task_info)
+            node = self.nodes.get(hostname)
+            if node is None:
+                raise KeyError(
+                    f"failed to bind task {task.uid} to host {hostname}: host not found"
+                )
+            job.update_task_status(task, TaskStatus.Binding)
+            task.node_name = hostname
+            node.add_task(task)
+
+        def effect():
+            try:
+                if self.binder is not None:
+                    self.binder.bind(task, hostname)
+            except Exception as e:  # noqa: BLE001
+                log.error("bind of %s/%s failed: %s", task.namespace, task.name, e)
+                self.resync_task(task)
+
+        self._run_effect(effect)
+
+    def evict(self, task_info: TaskInfo, reason: str) -> None:
+        """cache.go:498-554."""
+        with self._mutex:
+            job, task = self._find_job_and_task(task_info)
+            node = self.nodes.get(task.node_name)
+            if node is None:
+                raise KeyError(
+                    f"failed to evict task {task.uid}: host {task.node_name} not found"
+                )
+            job.update_task_status(task, TaskStatus.Releasing)
+            node.update_task(task)
+
+        def effect():
+            try:
+                if self.evictor is not None:
+                    self.evictor.evict(task)
+            except Exception as e:  # noqa: BLE001
+                log.error("evict of %s/%s failed: %s", task.namespace, task.name, e)
+                self.resync_task(task)
+
+        self._run_effect(effect)
+
+    def resync_task(self, task: TaskInfo) -> None:
+        """Requeue for resync from API truth (cache.go:687-709)."""
+        with self._mutex:
+            self.err_tasks.append(task)
+        if self.client is not None:
+            self.process_resync_task()
+
+    def process_resync_task(self) -> None:
+        """Re-fetch the pod and rebuild the task (cache.go syncTask)."""
+        with self._mutex:
+            if not self.err_tasks:
+                return
+            task = self.err_tasks.pop(0)
+        if self.client is None:
+            return
+        pod = self.client.get_pod(task.namespace, task.name)
+        with self._mutex:
+            self._delete_task(task)
+            if pod is not None:
+                self._add_task(new_task_info(pod))
+
+    # ---- status writeback ----
+
+    def record_job_status_event(self, job: JobInfo) -> None:
+        """cache.go:832-867 — pod conditions for unschedulable tasks."""
+        if self.status_updater is None:
+            return
+        base_message = job.job_fit_errors
+        for task in job.tasks.values():
+            if task.status != TaskStatus.Pending:
+                continue
+            fit_errors = job.nodes_fit_errors.get(task.uid)
+            message = fit_errors.error() if fit_errors is not None else base_message
+            try:
+                self.status_updater.update_pod_condition(task, "Unschedulable", message)
+            except Exception as e:  # noqa: BLE001
+                log.error("update pod condition failed: %s", e)
+
+    def update_job_status(self, job: JobInfo) -> Optional[scheduling.PodGroup]:
+        """cache.go:871-894."""
+        self.record_job_status_event(job)
+        if self.status_updater is None or job.pod_group is None:
+            return job.pod_group
+        return self.status_updater.update_pod_group(job.pod_group)

@@ -1,0 +1,22 @@
+"""Session events fired at every allocate/deallocate mutation.
+
+Reference: pkg/scheduler/framework/events.go.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from volcano_tpu.api import TaskInfo
+
+
+@dataclass
+class Event:
+    task: TaskInfo
+
+
+@dataclass
+class EventHandler:
+    allocate_func: Optional[Callable[[Event], None]] = None
+    deallocate_func: Optional[Callable[[Event], None]] = None

@@ -1,0 +1,61 @@
+"""Parallel job status writeback at session close.
+
+Reference: pkg/scheduler/framework/job_updater.go.  The reference fans out
+over 16 goroutines; host-side Python uses a thread pool for the same effect
+(the writes are I/O-bound API calls).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import TYPE_CHECKING, List
+
+from volcano_tpu.api import JobInfo
+from volcano_tpu.utils.logging import get_logger
+
+if TYPE_CHECKING:
+    from volcano_tpu.framework.session import Session
+
+log = get_logger(__name__)
+
+_WORKERS = 16
+
+
+def is_pod_group_status_updated(old, new) -> bool:
+    """job_updater.go:56-76 — compare phase, counts and conditions."""
+    if old is None or new is None:
+        return True
+    if old.phase != new.phase:
+        return True
+    if (old.running, old.succeeded, old.failed) != (new.running, new.succeeded, new.failed):
+        return True
+    old_conds = {(c.type, c.status, c.reason, c.message) for c in old.conditions}
+    new_conds = {(c.type, c.status, c.reason, c.message) for c in new.conditions}
+    return old_conds != new_conds
+
+
+class JobUpdater:
+    def __init__(self, ssn: "Session"):
+        self.ssn = ssn
+        self.job_queue: List[JobInfo] = list(ssn.jobs.values())
+
+    def _update_job(self, job: JobInfo) -> None:
+        ssn = self.ssn
+        if job.pod_group is None:
+            return
+        job.pod_group.status = ssn.job_status(job)
+        old_status = ssn.pod_group_status.get(job.uid)
+        if is_pod_group_status_updated(old_status, job.pod_group.status):
+            try:
+                ssn.cache.update_job_status(job)
+            except Exception as e:  # noqa: BLE001 — next session retries
+                log.error("Failed to update job status <%s/%s>: %s", job.namespace, job.name, e)
+
+    def update_all(self) -> None:
+        if not self.job_queue:
+            return
+        if len(self.job_queue) == 1:
+            self._update_job(self.job_queue[0])
+            return
+        with ThreadPoolExecutor(max_workers=_WORKERS) as pool:
+            list(pool.map(self._update_job, self.job_queue))

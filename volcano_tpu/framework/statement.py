@@ -1,0 +1,128 @@
+"""Statement — the session transaction log enabling gang all-or-nothing.
+
+Reference: pkg/scheduler/framework/statement.go.  Operations apply to the
+session state immediately (so subsequent decisions see them) and are logged;
+Commit flushes side effects through the cache, Discard unwinds in reverse.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple, TYPE_CHECKING
+
+from volcano_tpu.api import TaskInfo, TaskStatus
+from volcano_tpu.framework.events import Event
+from volcano_tpu.utils.logging import get_logger
+
+if TYPE_CHECKING:
+    from volcano_tpu.framework.session import Session
+
+log = get_logger(__name__)
+
+
+class Statement:
+    def __init__(self, ssn: "Session"):
+        self.ssn = ssn
+        self.operations: List[Tuple[str, tuple]] = []
+
+    # ---- evict (statement.go:40-113) ----
+
+    def evict(self, reclaimee: TaskInfo, reason: str) -> None:
+        job = self.ssn.jobs.get(reclaimee.job)
+        if job is not None:
+            job.update_task_status(reclaimee, TaskStatus.Releasing)
+        node = self.ssn.nodes.get(reclaimee.node_name)
+        if node is not None:
+            node.update_task(reclaimee)
+        self.ssn._fire_deallocate(reclaimee)
+        self.operations.append(("evict", (reclaimee, reason)))
+
+    def _commit_evict(self, reclaimee: TaskInfo, reason: str) -> None:
+        try:
+            self.ssn.cache.evict(reclaimee, reason)
+        except Exception as e:  # noqa: BLE001 — bind/evict failures resync later
+            log.error("Failed to evict task %s/%s: %s", reclaimee.namespace, reclaimee.name, e)
+            self._unevict(reclaimee)
+
+    def _unevict(self, reclaimee: TaskInfo) -> None:
+        job = self.ssn.jobs.get(reclaimee.job)
+        if job is not None:
+            job.update_task_status(reclaimee, TaskStatus.Running)
+        node = self.ssn.nodes.get(reclaimee.node_name)
+        if node is not None:
+            node.update_task(reclaimee)
+        self.ssn._fire_allocate(reclaimee)
+
+    # ---- pipeline (statement.go:116-196) ----
+
+    def pipeline(self, task: TaskInfo, hostname: str) -> None:
+        job = self.ssn.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.Pipelined)
+        task.node_name = hostname
+        node = self.ssn.nodes.get(hostname)
+        if node is not None:
+            node.add_task(task)
+        self.ssn._fire_allocate(task)
+        self.operations.append(("pipeline", (task, hostname)))
+
+    def _unpipeline(self, task: TaskInfo) -> None:
+        job = self.ssn.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.Pending)
+        node = self.ssn.nodes.get(task.node_name)
+        if node is not None:
+            node.remove_task(task)
+        self.ssn._fire_deallocate(task)
+
+    # ---- allocate (statement.go:199-305) ----
+
+    def allocate(self, task: TaskInfo, hostname: str) -> None:
+        self.ssn.cache.allocate_volumes(task, hostname)
+        job = self.ssn.jobs.get(task.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task.job}")
+        job.update_task_status(task, TaskStatus.Allocated)
+        task.node_name = hostname
+        node = self.ssn.nodes.get(hostname)
+        if node is None:
+            raise KeyError(f"failed to find node {hostname}")
+        node.add_task(task)
+        self.ssn._fire_allocate(task)
+        self.operations.append(("allocate", (task, hostname)))
+
+    def _commit_allocate(self, task: TaskInfo, hostname: str) -> None:
+        self.ssn.cache.bind_volumes(task)
+        self.ssn.cache.bind(task, task.node_name)
+        job = self.ssn.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.Binding)
+
+    def _unallocate(self, task: TaskInfo) -> None:
+        job = self.ssn.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.Pending)
+        node = self.ssn.nodes.get(task.node_name)
+        if node is not None:
+            node.remove_task(task)
+        self.ssn._fire_deallocate(task)
+
+    # ---- transaction end (statement.go:308-337) ----
+
+    def discard(self) -> None:
+        for name, args in reversed(self.operations):
+            if name == "evict":
+                self._unevict(args[0])
+            elif name == "pipeline":
+                self._unpipeline(args[0])
+            elif name == "allocate":
+                self._unallocate(args[0])
+        self.operations.clear()
+
+    def commit(self) -> None:
+        for name, args in self.operations:
+            if name == "evict":
+                self._commit_evict(*args)
+            elif name == "allocate":
+                self._commit_allocate(*args)
+            # pipeline has no cache-side commit (statement.go:158-159)
+        self.operations.clear()

@@ -1,0 +1,3 @@
+from volcano_tpu.metrics import metrics
+
+__all__ = ["metrics"]

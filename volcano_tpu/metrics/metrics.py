@@ -1,0 +1,158 @@
+"""Scheduler metrics — the reference's Prometheus catalog, host-side.
+
+Reference: pkg/scheduler/metrics/metrics.go:38-191.  Same metric names under
+the ``volcano`` namespace; implemented as in-process histograms/counters with
+an optional Prometheus text exposition (no hard dependency on a client lib).
+The TPU build adds kernel phase timings (compile/transfer/execute) under the
+same registry.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+_NAMESPACE = "volcano"
+
+# 5ms × 2^k buckets, like prometheus.ExponentialBuckets(5, 2, 10) in ms.
+_LATENCY_BUCKETS_MS = [5.0 * (2**k) for k in range(10)]
+
+
+class _Histogram:
+    def __init__(self, name: str, help_: str, buckets: List[float]):
+        self.name = name
+        self.help = help_
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.sum = 0.0
+        self.total = 0
+
+    def observe(self, value: float) -> None:
+        idx = bisect.bisect_left(self.buckets, value)
+        self.counts[idx] += 1
+        self.sum += value
+        self.total += 1
+
+
+class _Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._histograms: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], _Histogram] = {}
+        self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = defaultdict(float)
+        self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+
+    def histogram(self, name: str, labels: Dict[str, str], help_: str = "") -> _Histogram:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                h = _Histogram(name, help_, _LATENCY_BUCKETS_MS)
+                self._histograms[key] = h
+            return h
+
+    def inc(self, name: str, labels: Dict[str, str], value: float = 1.0) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._counters[key] += value
+
+    def set_gauge(self, name: str, labels: Dict[str, str], value: float) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._gauges[key] = value
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+
+        def fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+            if not labels:
+                return ""
+            inner = ",".join(f'{k}="{v}"' for k, v in labels)
+            return "{" + inner + "}"
+
+        with self._lock:
+            for (name, labels), h in sorted(self._histograms.items()):
+                cumulative = 0
+                for bound, c in zip(h.buckets, h.counts):
+                    cumulative += c
+                    le = labels + (("le", str(bound)),)
+                    lines.append(f"{name}_bucket{fmt_labels(le)} {cumulative}")
+                le = labels + (("le", "+Inf"),)
+                lines.append(f"{name}_bucket{fmt_labels(le)} {h.total}")
+                lines.append(f"{name}_sum{fmt_labels(labels)} {h.sum}")
+                lines.append(f"{name}_count{fmt_labels(labels)} {h.total}")
+            for (name, labels), v in sorted(self._counters.items()):
+                lines.append(f"{name}{fmt_labels(labels)} {v}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                lines.append(f"{name}{fmt_labels(labels)} {v}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._histograms.clear()
+            self._counters.clear()
+            self._gauges.clear()
+
+
+registry = _Registry()
+
+
+# ---- update helpers (metrics.go:124-171) ----
+
+def update_plugin_duration(plugin_name: str, seconds: float) -> None:
+    registry.histogram(
+        f"{_NAMESPACE}_plugin_scheduling_latency_microseconds", {"plugin": plugin_name}
+    ).observe(seconds * 1e3)
+
+
+def update_action_duration(action_name: str, seconds: float) -> None:
+    registry.histogram(
+        f"{_NAMESPACE}_action_scheduling_latency_microseconds", {"action": action_name}
+    ).observe(seconds * 1e3)
+
+
+def update_e2e_duration(seconds: float) -> None:
+    registry.histogram(
+        f"{_NAMESPACE}_e2e_scheduling_latency_milliseconds", {}
+    ).observe(seconds * 1e3)
+
+
+def update_task_schedule_duration(seconds: float) -> None:
+    registry.histogram(
+        f"{_NAMESPACE}_task_scheduling_latency_microseconds", {}
+    ).observe(seconds * 1e3)
+
+
+def update_pod_schedule_status(status: str, count: int = 1) -> None:
+    registry.inc(f"{_NAMESPACE}_pod_schedule_{status}", {}, count)
+
+
+def update_preemption_victims_count(count: int) -> None:
+    registry.inc(f"{_NAMESPACE}_total_preemption_victims", {}, count)
+
+
+def register_preemption_attempts() -> None:
+    registry.inc(f"{_NAMESPACE}_total_preemption_attempts", {})
+
+
+def update_unschedule_task_count(job_name: str, count: int) -> None:
+    registry.set_gauge(f"{_NAMESPACE}_unschedule_task_count", {"job": job_name}, count)
+
+
+def update_unschedule_job_count(count: int) -> None:
+    registry.set_gauge(f"{_NAMESPACE}_unschedule_job_count", {}, count)
+
+
+def register_job_retries(job_name: str) -> None:
+    registry.inc(f"{_NAMESPACE}_job_retry_counts", {"job": job_name})
+
+
+# ---- TPU-build additions: per-kernel phase timings ----
+
+def update_kernel_duration(phase: str, seconds: float) -> None:
+    """phase ∈ {compile, transfer, execute} for the device session kernel."""
+    registry.histogram(
+        f"{_NAMESPACE}_tpu_kernel_latency_milliseconds", {"phase": phase}
+    ).observe(seconds * 1e3)

@@ -1,0 +1,1 @@
+"""Scheduler loop, cache and helpers (reference: pkg/scheduler)."""

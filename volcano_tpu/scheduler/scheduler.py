@@ -1,0 +1,98 @@
+"""Scheduler loop — load conf, open session, run actions, close session.
+
+Reference: pkg/scheduler/scheduler.go.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+from volcano_tpu import actions as _actions  # registers actions
+from volcano_tpu import plugins as _plugins  # registers plugin builders
+from volcano_tpu.cache.interface import Cache
+from volcano_tpu.conf import (
+    SchedulerConf,
+    default_scheduler_conf,
+    load_scheduler_conf,
+)
+from volcano_tpu.framework import close_session, get_action, open_session
+from volcano_tpu.framework.interface import Action
+from volcano_tpu.metrics import metrics
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+DEFAULT_SCHEDULE_PERIOD = 1.0  # options.go:28
+
+
+class Scheduler:
+    """scheduler.go:45-106."""
+
+    def __init__(
+        self,
+        cache: Cache,
+        scheduler_conf_path: str = "",
+        period: float = DEFAULT_SCHEDULE_PERIOD,
+    ):
+        self.cache = cache
+        self.scheduler_conf_path = scheduler_conf_path
+        self.period = period
+        self._stopped = False
+
+    def _load_conf(self) -> SchedulerConf:
+        """Hot-reload every cycle (scheduler.go:77,89-106)."""
+        if self.scheduler_conf_path and os.path.exists(self.scheduler_conf_path):
+            try:
+                with open(self.scheduler_conf_path) as f:
+                    return load_scheduler_conf(f.read())
+            except Exception as e:  # noqa: BLE001 — fall back to defaults
+                log.error("Failed to load scheduler conf: %s", e)
+        return default_scheduler_conf()
+
+    def _resolve_actions(self, conf: SchedulerConf) -> List[Action]:
+        out = []
+        for name in conf.actions:
+            action = get_action(name)
+            if action is None:
+                log.error("Failed to find action %s", name)
+                continue
+            out.append(action)
+        return out
+
+    def run_once(self) -> None:
+        """scheduler.go:71-87."""
+        start = time.perf_counter()
+        conf = self._load_conf()
+        actions = self._resolve_actions(conf)
+
+        ssn = open_session(self.cache, conf.tiers, conf.configurations)
+        try:
+            for action in actions:
+                action_start = time.perf_counter()
+                action.execute(ssn)
+                metrics.update_action_duration(
+                    action.name(), time.perf_counter() - action_start
+                )
+        finally:
+            close_session(ssn)
+        metrics.update_e2e_duration(time.perf_counter() - start)
+
+    def run(self, cycles: Optional[int] = None) -> None:
+        """scheduler.go:63-69 — wait.Until(runOnce, period)."""
+        self.cache.run()
+        self.cache.wait_for_cache_sync()
+        n = 0
+        while not self._stopped:
+            cycle_start = time.monotonic()
+            self.run_once()
+            n += 1
+            if cycles is not None and n >= cycles:
+                break
+            sleep = self.period - (time.monotonic() - cycle_start)
+            if sleep > 0:
+                time.sleep(sleep)
+
+    def stop(self) -> None:
+        self._stopped = True

@@ -24,6 +24,13 @@ def v(level: int) -> bool:
     return _LEVEL >= level
 
 
+def get_logger(name: str = "volcano_tpu") -> logging.Logger:
+    """Child logger sharing the root handler/level."""
+    if name == "volcano_tpu" or name.startswith("volcano_tpu."):
+        return logging.getLogger(name)
+    return _logger.getChild(name)
+
+
 def info(msg: str, *args, level: int = 0) -> None:
     if _LEVEL >= level:
         _logger.info(msg, *args)
