@@ -1,0 +1,153 @@
+"""jax-allocate equivalence: the device-backed action must produce
+bindings identical to the host allocate action on the same snapshot —
+the north-star contract (BASELINE.md: "identical bindings")."""
+
+from __future__ import annotations
+
+import pytest
+
+from volcano_tpu.actions.allocate import AllocateAction
+from volcano_tpu.actions.jax_allocate import JaxAllocateAction, compute_task_order
+from volcano_tpu.framework import open_session, close_session
+
+from tests.builders import build_node, build_pod, build_pod_group, build_queue
+from tests.scheduler_helpers import make_cache, run_actions, tiers
+
+TIERS = lambda: tiers(["priority", "gang"], ["drf", "predicates", "proportion", "nodeorder", "binpack"])
+
+
+def _bindings(cache, action):
+    run_actions(cache, [action], TIERS())
+    return dict(cache.binder.binds)
+
+
+def _case_multi_job_spread():
+    nodes = [
+        build_node(f"n{i}", {"cpu": str(4 + (i % 3) * 2), "memory": "16G"})
+        for i in range(8)
+    ]
+    pods, pgs = [], []
+    for j in range(5):
+        pgs.append(build_pod_group("ns", f"pg{j}", 2, queue="q"))
+        for i in range(3):
+            pods.append(
+                build_pod("ns", f"j{j}-t{i}", "", {"cpu": "2", "memory": "2G"}, group=f"pg{j}")
+            )
+    return dict(nodes=nodes, pods=pods, pod_groups=pgs, queues=[build_queue("q")])
+
+
+def _case_multi_queue_fairshare():
+    nodes = [build_node(f"n{i}", {"cpu": "8", "memory": "32G"}) for i in range(4)]
+    pods, pgs = [], []
+    queues = [build_queue("qa", weight=3), build_queue("qb", weight=1)]
+    for j, q in [(0, "qa"), (1, "qa"), (2, "qb")]:
+        pgs.append(build_pod_group("ns", f"pg{j}", 1, queue=q))
+        for i in range(4):
+            pods.append(
+                build_pod("ns", f"j{j}-t{i}", "", {"cpu": "2", "memory": "4G"}, group=f"pg{j}")
+            )
+    return dict(nodes=nodes, pods=pods, pod_groups=pgs, queues=queues)
+
+
+def _case_multi_namespace():
+    nodes = [build_node(f"n{i}", {"cpu": "4", "memory": "8G"}) for i in range(3)]
+    pods, pgs = [], []
+    for ns in ("alpha", "beta"):
+        pgs.append(build_pod_group(ns, "pg", 0, queue="q"))
+        for i in range(3):
+            pods.append(
+                build_pod(ns, f"t{i}", "", {"cpu": "1", "memory": "1G"}, group="pg")
+            )
+    return dict(nodes=nodes, pods=pods, pod_groups=pgs, queues=[build_queue("q")])
+
+
+def _case_gang_partial_discard():
+    nodes = [build_node("n0", {"cpu": "4", "memory": "8G"})]
+    pods, pgs = [], []
+    pgs.append(build_pod_group("ns", "fits", 2, queue="q"))
+    for i in range(2):
+        pods.append(build_pod("ns", f"f{i}", "", {"cpu": "1", "memory": "1G"}, group="fits"))
+    pgs.append(build_pod_group("ns", "toobig", 4, queue="q"))
+    for i in range(4):
+        pods.append(build_pod("ns", f"b{i}", "", {"cpu": "1", "memory": "1G"}, group="toobig"))
+    return dict(nodes=nodes, pods=pods, pod_groups=pgs, queues=[build_queue("q")])
+
+
+@pytest.mark.parametrize(
+    "case",
+    [
+        _case_multi_job_spread,
+        _case_multi_queue_fairshare,
+        _case_multi_namespace,
+        _case_gang_partial_discard,
+    ],
+)
+def test_jax_allocate_bindings_match_host(case):
+    args = case()
+    host = _bindings(make_cache(**args), AllocateAction())
+    dev = _bindings(make_cache(**args), JaxAllocateAction())
+    assert host == dev
+    # sanity: the scenario actually schedules something (except pure-discard)
+    if case is not _case_gang_partial_discard:
+        assert host
+
+
+def test_compute_task_order_is_side_effect_free():
+    """The order replay must leave session state untouched."""
+    args = _case_multi_job_spread()
+    cache = make_cache(**args)
+    ssn = open_session(cache, TIERS(), [])
+    try:
+        before = {
+            uid: {t.uid: t.status for t in job.tasks.values()}
+            for uid, job in ssn.jobs.items()
+        }
+        order = compute_task_order(ssn)
+        after = {
+            uid: {t.uid: t.status for t in job.tasks.values()}
+            for uid, job in ssn.jobs.items()
+        }
+        assert before == after
+        assert len(order) == len({t.uid for t in order})
+        # Interleave property: jobs are popped round-robin until gang-ready
+        # (minAvailable=2 here), so each job's first two tasks must all
+        # precede any job's third task — the first 2×5 entries cover every
+        # job exactly twice.
+        n_jobs = 5
+        head = order[: 2 * n_jobs]
+        counts = {}
+        for t in head:
+            counts[t.job] = counts.get(t.job, 0) + 1
+        assert counts == {f"ns/pg{j}": 2 for j in range(n_jobs)}, counts
+    finally:
+        close_session(ssn)
+
+
+def test_jax_allocate_with_predicates_case():
+    from volcano_tpu.apis import core
+
+    def mk():
+        return make_cache(
+            nodes=[
+                build_node("n1", {"cpu": "8", "memory": "16G"}, labels={"zone": "a"}),
+                build_node(
+                    "n2", {"cpu": "8", "memory": "16G"},
+                    taints=[core.Taint(key="dedicated", value="x", effect="NoSchedule")],
+                ),
+                build_node("n3", {"cpu": "8", "memory": "16G"}),
+            ],
+            pods=[
+                build_pod("ns", "sel", "", {"cpu": "1", "memory": "1G"}, group="pg",
+                          selector={"zone": "a"}),
+                build_pod("ns", "tol", "", {"cpu": "1", "memory": "1G"}, group="pg",
+                          tolerations=[core.Toleration(key="dedicated", value="x", effect="NoSchedule")]),
+                build_pod("ns", "any", "", {"cpu": "1", "memory": "1G"}, group="pg"),
+            ],
+            pod_groups=[build_pod_group("ns", "pg", 0, queue="q")],
+            queues=[build_queue("q")],
+        )
+
+    host = _bindings(mk(), AllocateAction())
+    dev = _bindings(mk(), JaxAllocateAction())
+    assert host == dev
+    assert host["ns/sel"] == "n1"

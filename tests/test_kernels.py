@@ -1,0 +1,244 @@
+"""Device kernel tests: score math goldens vs host plugins, and
+bindings-equivalence of the packed session kernel vs the host allocate
+path on identical snapshots (the north-star contract)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import volcano_tpu.scheduler.util as sched_util
+from volcano_tpu.api import TaskStatus, new_task_info, NodeInfo
+from volcano_tpu.framework.arguments import Arguments
+from volcano_tpu.ops import (
+    ScoreWeights,
+    pack_session,
+    run_packed,
+)
+from volcano_tpu.ops.kernels import (
+    balanced_resource_score,
+    binpack_score,
+    least_requested_score,
+)
+from volcano_tpu.plugins.binpack import PriorityWeight, bin_packing_score
+from volcano_tpu.plugins.nodeorder import (
+    balanced_resource_priority,
+    least_requested_priority,
+)
+
+from tests.builders import build_node, build_pod, build_pod_group, build_queue
+from tests.scheduler_helpers import make_cache, run_actions, tiers
+
+
+def _host_score_inputs(ncpu, nmem, used_cpu, used_mem, req_cpu, req_mem):
+    node = NodeInfo(build_node("n", {"cpu": str(ncpu), "memory": str(int(nmem))}))
+    pod = build_pod("ns", "p", "", {"cpu": str(req_cpu), "memory": str(int(req_mem))})
+    task = new_task_info(pod)
+    if used_cpu or used_mem:
+        filler = new_task_info(
+            build_pod(
+                "ns", "filler", "n",
+                {"cpu": str(used_cpu), "memory": str(int(used_mem))},
+                phase="Running",
+            )
+        )
+        node.add_task(filler)
+    return task, node
+
+
+GI = 1024**3
+MI = 1024**2
+
+
+@pytest.mark.parametrize(
+    "ncpu,nmem,used_cpu,used_mem,req_cpu,req_mem",
+    [
+        (4, 8 * GI, 0, 0, 1, 1 * GI),
+        (4, 8 * GI, 2, 2 * GI, 1, 1 * GI),
+        (16, 64 * GI, 7, 40 * GI, 3, 10 * GI),
+        (2, 4 * GI, 1, 3 * GI, 1, 1 * GI),
+        (8, 33 * GI + 512 * MI, 3, 7 * GI + 256 * MI, 1, 2 * GI + 128 * MI),
+    ],
+)
+def test_score_goldens_match_host_plugins(ncpu, nmem, used_cpu, used_mem, req_cpu, req_mem):
+    """Device closed-form scores == host plugin math on the same state.
+    Device memory lanes are MiB-quantized (ops/packing.py), so the
+    exactness contract covers MiB-aligned quantities."""
+    task, node = _host_score_inputs(ncpu, nmem, used_cpu, used_mem, req_cpu, req_mem)
+
+    resreq = np.array([[task.resreq.milli_cpu, task.resreq.memory / MI]], dtype=np.float32)
+    used = np.array([[node.used.milli_cpu, node.used.memory / MI]], dtype=np.float32)
+    alloc = np.array([[node.allocatable.milli_cpu, node.allocatable.memory / MI]], dtype=np.float32)
+
+    host_bp = bin_packing_score(task, node, PriorityWeight())
+    dev_bp = float(binpack_score(resreq, used, alloc, ScoreWeights())[0, 0])
+    assert dev_bp == pytest.approx(host_bp, rel=1e-5)
+
+    host_lr = least_requested_priority(
+        node.used.milli_cpu + task.resreq.milli_cpu,
+        node.used.memory + task.resreq.memory,
+        node.allocatable.milli_cpu,
+        node.allocatable.memory,
+    )
+    dev_lr = float(least_requested_score(resreq, used, alloc)[0, 0])
+    assert dev_lr == host_lr
+
+    host_ba = balanced_resource_priority(
+        node.used.milli_cpu + task.resreq.milli_cpu,
+        node.used.memory + task.resreq.memory,
+        node.allocatable.milli_cpu,
+        node.allocatable.memory,
+    )
+    dev_ba = float(balanced_resource_score(resreq, used, alloc)[0, 0])
+    assert dev_ba == host_ba
+
+
+def _host_bindings(cache):
+    """Run the host allocate on the cache; return {task_key: node}."""
+    from volcano_tpu.actions.allocate import AllocateAction
+
+    sched_util._last_processed_node_index = 0
+    run_actions(
+        cache, [AllocateAction()], tiers(["gang"], ["drf", "predicates", "proportion", "nodeorder", "binpack"])
+    )
+    return dict(cache.binder.binds)
+
+
+def _device_bindings(cache):
+    """Pack the same snapshot, run the kernel, return {task_key: node}."""
+    snapshot = cache.snapshot()
+    jobs = sorted(snapshot.jobs.values(), key=lambda j: j.uid)
+    tasks = []
+    for job in jobs:
+        pending = sorted(
+            job.task_status_index.get(TaskStatus.Pending, {}).values(),
+            key=lambda t: t.uid,
+        )
+        tasks.extend(t for t in pending if not t.resreq.is_empty())
+    nodes = [snapshot.nodes[name] for name in sorted(snapshot.nodes)]
+    snap = pack_session(tasks, jobs, nodes)
+    assignment = run_packed(snap)
+    out = {}
+    for i, t in enumerate(tasks):
+        if assignment[i] >= 0:
+            out[f"{t.namespace}/{t.name}"] = nodes[assignment[i]].name
+    return out
+
+
+def _mk_case(nodes, pods, pod_groups, queues):
+    return make_cache(nodes=nodes, pods=pods, pod_groups=pod_groups, queues=queues)
+
+
+def test_kernel_matches_host_simple_fill():
+    args = dict(
+        nodes=[
+            build_node("n1", {"cpu": "4", "memory": "8G"}),
+            build_node("n2", {"cpu": "4", "memory": "8G"}),
+        ],
+        pods=[
+            build_pod("ns", f"p{i}", "", {"cpu": "1", "memory": "1G"}, group="pg1")
+            for i in range(4)
+        ],
+        pod_groups=[build_pod_group("ns", "pg1", 0, queue="q")],
+        queues=[build_queue("q")],
+    )
+    host = _host_bindings(_mk_case(**args))
+    dev = _device_bindings(_mk_case(**args))
+    assert host == dev
+    assert len(host) == 4
+
+
+def test_kernel_matches_host_gang_discard():
+    """Gang job that cannot fully fit must bind nothing on both paths."""
+    args = dict(
+        nodes=[build_node("n1", {"cpu": "2", "memory": "4G"})],
+        pods=[
+            build_pod("ns", f"p{i}", "", {"cpu": "1", "memory": "1G"}, group="pg1")
+            for i in range(3)
+        ],
+        pod_groups=[build_pod_group("ns", "pg1", 3, queue="q")],
+        queues=[build_queue("q")],
+    )
+    host = _host_bindings(_mk_case(**args))
+    dev = _device_bindings(_mk_case(**args))
+    assert host == dev == {}
+
+
+def test_kernel_matches_host_selector_and_taints():
+    from volcano_tpu.apis import core
+
+    def mk():
+        return _mk_case(
+            nodes=[
+                build_node("n1", {"cpu": "8", "memory": "16G"}, labels={"disk": "ssd"}),
+                build_node(
+                    "n2", {"cpu": "8", "memory": "16G"},
+                    taints=[core.Taint(key="gpu", value="yes", effect="NoSchedule")],
+                ),
+                build_node("n3", {"cpu": "8", "memory": "16G"}),
+            ],
+            pods=[
+                build_pod("ns", "pssd", "", {"cpu": "1", "memory": "1G"},
+                          group="pg1", selector={"disk": "ssd"}),
+                build_pod("ns", "ptol", "", {"cpu": "1", "memory": "1G"}, group="pg1",
+                          tolerations=[core.Toleration(key="gpu", value="yes", effect="NoSchedule")]),
+                build_pod("ns", "plain", "", {"cpu": "1", "memory": "1G"}, group="pg1"),
+            ],
+            pod_groups=[build_pod_group("ns", "pg1", 0, queue="q")],
+            queues=[build_queue("q")],
+        )
+
+    host = _host_bindings(mk())
+    dev = _device_bindings(mk())
+    assert host == dev
+    assert host["ns/pssd"] == "n1"
+
+
+def test_kernel_matches_host_single_job_heterogeneous():
+    """One job over heterogeneous nodes: static kernel order == host order.
+    (Multi-job dynamic interleave equivalence is covered through the
+    jax-allocate action in tests/test_jax_allocate.py, which feeds the
+    kernel the replayed host order.)"""
+    nodes = [
+        build_node(f"n{i}", {"cpu": str(4 + (i % 3) * 2), "memory": "16G"})
+        for i in range(8)
+    ]
+    pods = [
+        build_pod("ns", f"t{i}", "", {"cpu": "2", "memory": "2G"}, group="pg0")
+        for i in range(9)
+    ]
+    args = dict(
+        nodes=nodes,
+        pods=pods,
+        pod_groups=[build_pod_group("ns", "pg0", 2, queue="q")],
+        queues=[build_queue("q")],
+    )
+    host = _host_bindings(_mk_case(**args))
+    dev = _device_bindings(_mk_case(**args))
+    assert host == dev
+
+
+def test_kernel_respects_existing_usage():
+    """Nodes with running pods: used/idle packed correctly."""
+    def mk():
+        cache = _mk_case(
+            nodes=[
+                build_node("n1", {"cpu": "4", "memory": "8G"}),
+                build_node("n2", {"cpu": "4", "memory": "8G"}),
+            ],
+            pods=[
+                build_pod("ns", "running", "n1", {"cpu": "3", "memory": "6G"},
+                          phase="Running", group="pg0"),
+                build_pod("ns", "new1", "", {"cpu": "2", "memory": "2G"}, group="pg1"),
+            ],
+            pod_groups=[
+                build_pod_group("ns", "pg0", 1, queue="q"),
+                build_pod_group("ns", "pg1", 1, queue="q"),
+            ],
+            queues=[build_queue("q")],
+        )
+        return cache
+
+    host = _host_bindings(mk())
+    dev = _device_bindings(mk())
+    assert host == dev == {"ns/new1": "n2"}

@@ -3,7 +3,7 @@ cmd/scheduler/main.go:36-38."""
 
 from volcano_tpu.framework.interface import register_action
 
-from volcano_tpu.actions import allocate, backfill, enqueue, preempt, reclaim
+from volcano_tpu.actions import allocate, backfill, enqueue, jax_allocate, preempt, reclaim
 
 
 def register_all() -> None:
@@ -12,6 +12,7 @@ def register_all() -> None:
     register_action(backfill.new())
     register_action(preempt.new())
     register_action(reclaim.new())
+    register_action(jax_allocate.new())
 
 
 register_all()

@@ -1,0 +1,170 @@
+"""jax-allocate — the allocate action with the O(tasks×nodes) hot loop on
+TPU.
+
+Reference behavior: pkg/scheduler/actions/allocate/allocate.go.  Design
+(SURVEY.md §7): the reference's per-task PredicateNodes/PrioritizeNodes/
+SelectBestNode (scheduler_helper.go:64-211) is replaced by one fused device
+kernel over the whole session; results apply through the same Statement so
+gang commit/discard and plugin event handlers stay intact.
+
+Three phases, all built on the single control-flow skeleton in
+actions/allocate.py (drive_allocate_loop):
+
+1. ORDER — replay the control flow *without placements* to obtain the task
+   processing order.  Exact because every order-determining quantity (DRF
+   share, proportion queue share/overused, gang readiness, priorities)
+   updates from task resreqs only, never from which node a task landed on.
+   The replay mutates session accounting through the real event handlers
+   and then unwinds itself, Statement-style.
+2. KERNEL — pack the snapshot (ops/packing.py) and run the fused
+   predicate+score+assign scan (ops/kernels.py) over the ordered tasks.
+3. APPLY — run the real control flow, placing each task on its kernel-
+   proposed node after an O(1) host validation (plugin predicates + fit on
+   that node only); tasks whose proposal fails validation — and tasks the
+   kernel cannot score faithfully (preferred-affinity terms) — fall back
+   to the host scoring path for that task alone.
+
+Bindings equivalence: with deterministic tie-break, phase 2's argmax equals
+the host path's SelectBestNode per task, so bindings are identical whenever
+every ordered task is placeable (tests/test_jax_allocate.py).  When a
+placement fails (capacity race against the static proposal), the fallback
+keeps the result valid — semantics never degrade below the host action.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from volcano_tpu.actions.allocate import (
+    drive_allocate_loop,
+    gang_end_job,
+    host_node_chooser,
+    make_place_task,
+    make_predicate_fn,
+)
+from volcano_tpu.api import FitError, TaskInfo, TaskStatus
+from volcano_tpu.framework.interface import Action
+from volcano_tpu.framework.session import Session
+from volcano_tpu.metrics import metrics
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def compute_task_order(ssn: Session) -> List[TaskInfo]:
+    """Phase 1: replay the loop assuming every task places, recording pop
+    order; then unwind all accounting (reverse order, like
+    Statement.Discard)."""
+    order: List[TaskInfo] = []
+    touched: List[Tuple[TaskInfo, TaskStatus]] = []
+
+    def place_task(_ctx, task: TaskInfo, job) -> bool:
+        order.append(task)
+        touched.append((task, task.status))
+        job.update_task_status(task, TaskStatus.Allocated)
+        ssn._fire_allocate(task)
+        return True
+
+    drive_allocate_loop(
+        ssn,
+        begin_job=lambda job: None,
+        place_task=place_task,
+        end_job=lambda ctx, job: None,
+    )
+
+    for task, prior_status in reversed(touched):
+        job = ssn.jobs[task.job]
+        job.update_task_status(task, prior_status)
+        ssn._fire_deallocate(task)
+
+    return order
+
+
+class JaxAllocateAction(Action):
+    def __init__(self, weights=None, gang_rounds: int = 3):
+        from volcano_tpu.ops.kernels import DEFAULT_WEIGHTS
+
+        self.weights = weights or DEFAULT_WEIGHTS
+        self.gang_rounds = gang_rounds
+
+    def name(self) -> str:
+        return "jax-allocate"
+
+    # ---- phase 2 ----
+
+    def _kernel_proposals(
+        self, ssn: Session, ordered_tasks: List[TaskInfo]
+    ) -> Dict[str, str]:
+        """Pack + run the device kernel; {task uid → node name}.
+
+        Tasks flagged ``task_has_preferences`` are excluded — the kernel
+        has no lanes for preferred (anti-)affinity scores, so those route
+        to the host chooser.  Relational predicates the packer could not
+        encode (needs_host_validation) are safe regardless: phase 3
+        validates every proposal against the full host predicate set."""
+        from volcano_tpu.ops.kernels import run_packed
+        from volcano_tpu.ops.packing import pack_session
+
+        jobs = {}
+        for t in ordered_tasks:
+            job = ssn.jobs.get(t.job)
+            if job is not None and job.uid not in jobs:
+                jobs[job.uid] = job
+        nodes = [ssn.nodes[name] for name in sorted(ssn.nodes)]
+        if not nodes or not ordered_tasks:
+            return {}
+
+        t0 = time.perf_counter()
+        snap = pack_session(
+            ordered_tasks,
+            list(jobs.values()),
+            nodes,
+            enforce_pod_count="predicates" in ssn.predicate_fns,
+        )
+        metrics.update_kernel_duration("pack", time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        assignment = run_packed(snap, weights=self.weights, gang_rounds=self.gang_rounds)
+        metrics.update_kernel_duration("execute", time.perf_counter() - t0)
+
+        proposals = {}
+        for i, task in enumerate(ordered_tasks):
+            if assignment[i] >= 0 and not snap.task_has_preferences[i]:
+                proposals[task.uid] = nodes[assignment[i]].name
+        return proposals
+
+    # ---- phase 3 ----
+
+    def execute(self, ssn: Session) -> None:
+        ordered = compute_task_order(ssn)
+        if not ordered:
+            return
+        proposals = self._kernel_proposals(ssn, ordered)
+
+        predicate_fn = make_predicate_fn(ssn)
+        host_choose = host_node_chooser(ssn)
+
+        def choose_node(task: TaskInfo, job):
+            """Kernel proposal with O(1) validation; host path fallback."""
+            name = proposals.get(task.uid)
+            if name is not None:
+                node = ssn.nodes.get(name)
+                if node is not None:
+                    try:
+                        predicate_fn(task, node)
+                        return node
+                    except FitError:
+                        pass  # capacity/relational race → host fallback
+            return host_choose(task, job)
+
+        drive_allocate_loop(
+            ssn,
+            begin_job=lambda job: ssn.statement(),
+            place_task=make_place_task(ssn, choose_node),
+            end_job=gang_end_job(ssn),
+        )
+
+
+def new() -> JaxAllocateAction:
+    return JaxAllocateAction()

@@ -1,0 +1,34 @@
+"""Device (TPU) session kernels and snapshot packing.
+
+The compute core of the framework: the reference's O(tasks×nodes)
+predicate/score/assign loop re-designed as fused XLA programs over dense
+tensors (SURVEY.md §7).
+"""
+
+from volcano_tpu.ops.packing import BitRegistry, PackedSnapshot, pack_session
+from volcano_tpu.ops.kernels import (
+    DEFAULT_WEIGHTS,
+    ScoreWeights,
+    balanced_resource_score,
+    binpack_score,
+    least_requested_score,
+    node_scores,
+    predicate_mask,
+    run_packed,
+    schedule_session,
+)
+
+__all__ = [
+    "BitRegistry",
+    "PackedSnapshot",
+    "pack_session",
+    "DEFAULT_WEIGHTS",
+    "ScoreWeights",
+    "balanced_resource_score",
+    "binpack_score",
+    "least_requested_score",
+    "node_scores",
+    "predicate_mask",
+    "run_packed",
+    "schedule_session",
+]

@@ -1,0 +1,338 @@
+"""Device session kernels: fused predicate-mask + score + greedy gang assign.
+
+This replaces the reference's per-task 16-goroutine loop
+(pkg/scheduler/util/scheduler_helper.go:64-211 driven from
+pkg/scheduler/actions/allocate/allocate.go:191-224) with one jitted program:
+
+  1. predicate mask — broadcast comparisons + bitset ops over [T, N]
+     (replaces predicates.go:156-301 and the resource-fit closure
+     allocate.go:100-107)
+  2. score — closed-form binpack (binpack.go:248-259) +
+     least-requested/balanced (vendored k8s priorities) arithmetic
+  3. assignment — lax.scan over tasks in priority order with node state
+     (idle/used/count) carried, mirroring the sequential feedback of
+     Statement.Allocate; deterministic first-index tie-break
+  4. gang commit — jobs reaching min_available keep their placements,
+     others are discarded and the kernel re-runs without them (the
+     Statement.Commit/Discard semantics, statement.go:309-337) until a
+     fixpoint — at most gang_rounds device passes.
+
+Everything is static-shaped and branch-free inside jit; ties break to the
+lowest node index so host and device paths agree bindings-exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from volcano_tpu.ops.packing import PackedSnapshot
+
+MAX_PRIORITY = 10.0
+
+
+class ScoreWeights(NamedTuple):
+    """Plugin weights, matching binpack.go:94-151 + nodeorder.go:68-112.
+
+    ``binpack_scalar`` defaults to 0 because the host plugin skips scalar
+    resources absent from its ``binpack.resources`` weight map
+    (binpack.go:224-228 falls through to continue on unknown resources).
+    """
+
+    binpack_weight: float = 1.0
+    binpack_cpu: float = 1.0
+    binpack_memory: float = 1.0
+    binpack_scalar: float = 0.0  # host default: unknown scalars skipped
+    least_requested_weight: float = 1.0
+    balanced_resource_weight: float = 1.0
+
+
+DEFAULT_WEIGHTS = ScoreWeights()
+
+
+# ---- predicate mask (vectorized over all T×N pairs) ----
+
+def predicate_mask(
+    task_resreq: jnp.ndarray,  # [T, R]
+    task_sel_bits: jnp.ndarray,  # [T, W] uint32
+    task_tol_bits: jnp.ndarray,  # [T, W] uint32
+    node_future_idle: jnp.ndarray,  # [N, R]
+    node_label_bits: jnp.ndarray,  # [N, W]
+    node_taint_bits: jnp.ndarray,  # [N, W]
+    node_ok: jnp.ndarray,  # [N] bool
+    node_task_count: jnp.ndarray,  # [N] i32
+    node_max_tasks: jnp.ndarray,  # [N] i32
+    tolerance: jnp.ndarray,  # [R]
+) -> jnp.ndarray:
+    """[T, N] feasibility — resource fit (LessEqual w/ tolerance,
+    resource_info.go:292-326), selector/affinity bits, taint bits, pod
+    count, node readiness."""
+    # resreq <= future_idle with per-lane tolerance margin.  The
+    # sub-tolerance skip applies to scalar lanes only — host LessEqual
+    # (resource_info.go:292-326) short-circuits small *scalars* but still
+    # compares cpu/memory.
+    scalar_lane = jnp.arange(task_resreq.shape[-1]) >= 2
+    fit = jnp.all(
+        (task_resreq[:, None, :] < node_future_idle[None, :, :] + tolerance[None, None, :])
+        | (scalar_lane[None, None, :] & (task_resreq[:, None, :] <= tolerance[None, None, :])),
+        axis=-1,
+    )
+    # selector: every required label bit present on the node
+    sel_ok = jnp.all(
+        (task_sel_bits[:, None, :] & ~node_label_bits[None, :, :]) == 0, axis=-1
+    )
+    # taints: every node taint bit tolerated
+    tol_ok = jnp.all(
+        (node_taint_bits[None, :, :] & ~task_tol_bits[:, None, :]) == 0, axis=-1
+    )
+    room = (node_task_count < node_max_tasks)[None, :]
+    return fit & sel_ok & tol_ok & room & node_ok[None, :]
+
+
+# ---- scores (closed-form plugin math) ----
+
+def binpack_score(
+    task_resreq: jnp.ndarray,  # [T, R]
+    node_used: jnp.ndarray,  # [N, R]
+    node_alloc: jnp.ndarray,  # [N, R]
+    weights: ScoreWeights,
+) -> jnp.ndarray:
+    """[T, N] — binpack.go:200-259: per-resource (used+req)*w/alloc summed
+    over requested resources, normalized by summed weights, ×10×weight."""
+    R = task_resreq.shape[-1]
+    lane_w = jnp.concatenate(
+        [
+            jnp.array([weights.binpack_cpu, weights.binpack_memory], dtype=jnp.float32),
+            jnp.full((R - 2,), weights.binpack_scalar, dtype=jnp.float32),
+        ]
+    )
+    req = task_resreq[:, None, :]  # [T,1,R]
+    used_finally = req + node_used[None, :, :]
+    alloc = node_alloc[None, :, :]
+    requested_mask = req > 0
+    valid = requested_mask & (alloc > 0) & (used_finally <= alloc)
+    lane_score = jnp.where(valid, used_finally * lane_w / jnp.maximum(alloc, 1.0), 0.0)
+    score = jnp.sum(lane_score, axis=-1)
+    weight_sum = jnp.sum(jnp.where(requested_mask, lane_w, 0.0), axis=-1)
+    score = jnp.where(weight_sum > 0, score / weight_sum, 0.0)
+    return score * MAX_PRIORITY * weights.binpack_weight
+
+
+def least_requested_score(
+    task_resreq: jnp.ndarray, node_used: jnp.ndarray, node_alloc: jnp.ndarray
+) -> jnp.ndarray:
+    """[T, N] — least_requested.go:36-53 with the reference's integer floors:
+    ((cap-req)*10)//cap averaged over cpu+memory.
+
+    Computed in int32 so the floors are exact (float32 division can land a
+    hair under/over an integer and flip the floor).  Lanes are cpu-milli
+    and memory-MiB, both integer-valued and < 2^31/10 for any real node.
+    """
+    req = (task_resreq[:, None, :2] + node_used[None, :, :2]).astype(jnp.int32)
+    cap = node_alloc[None, :, :2].astype(jnp.int32)
+    lane = jnp.where(
+        (cap > 0) & (req <= cap),
+        (cap - req) * jnp.int32(MAX_PRIORITY) // jnp.maximum(cap, 1),
+        0,
+    )
+    return (jnp.sum(lane, axis=-1) // 2).astype(jnp.float32)
+
+
+def balanced_resource_score(
+    task_resreq: jnp.ndarray, node_used: jnp.ndarray, node_alloc: jnp.ndarray
+) -> jnp.ndarray:
+    """[T, N] — balanced_resource_allocation.go:41-70.
+
+    Fractions are computed in float32 (the host uses float64); the floor
+    can differ by 1 when (1-|Δfrac|)*10 sits within float32 eps of an
+    integer.  Bounded, rare, and only able to flip exact-tie argmaxes —
+    jax-allocate's validation keeps any such placement feasible."""
+    req = task_resreq[:, None, :2] + node_used[None, :, :2]
+    cap = node_alloc[None, :, :2]
+    frac = jnp.where(cap > 0, req / jnp.maximum(cap, 1.0), 1.0)
+    cpu_f, mem_f = frac[..., 0], frac[..., 1]
+    diff = jnp.abs(cpu_f - mem_f)
+    score = jnp.floor((1.0 - diff) * MAX_PRIORITY)
+    return jnp.where((cpu_f >= 1.0) | (mem_f >= 1.0), 0.0, score)
+
+
+def node_scores(
+    task_resreq: jnp.ndarray,
+    node_used: jnp.ndarray,
+    node_alloc: jnp.ndarray,
+    weights: ScoreWeights,
+) -> jnp.ndarray:
+    """[T, N] total score — the additive NodeOrderFn dispatch
+    (session_plugins.go:423-441)."""
+    s = binpack_score(task_resreq, node_used, node_alloc, weights)
+    s += weights.least_requested_weight * least_requested_score(
+        task_resreq, node_used, node_alloc
+    )
+    s += weights.balanced_resource_weight * balanced_resource_score(
+        task_resreq, node_used, node_alloc
+    )
+    return s
+
+
+# ---- greedy assignment scan ----
+
+class _ScanState(NamedTuple):
+    node_idle: jnp.ndarray  # [N, R]
+    node_used: jnp.ndarray  # [N, R]
+    node_task_count: jnp.ndarray  # [N]
+    job_assigned: jnp.ndarray  # [J]
+
+
+def _assign_step(
+    weights: ScoreWeights,
+    tolerance,
+    node_alloc,
+    node_max_tasks,
+    state: _ScanState,
+    task: Tuple,
+):
+    """One task: mask → score → argmax → tentative allocate.
+
+    Mirrors the per-task body of allocate.go:177-230 with the
+    resource-fit + plugin predicates folded into the mask and
+    SelectBestNode's tie-break made deterministic (first index)."""
+    resreq, sel_tol_row, job_idx, active = task
+    idle, used, count, job_assigned = state
+
+    # Dynamic parts of the predicate: resource fit vs *current* idle,
+    # pod-count room vs current count.  Sub-tolerance skip on scalar
+    # lanes only (see predicate_mask).
+    scalar_lane = jnp.arange(resreq.shape[-1]) >= 2
+    fit = jnp.all(
+        (resreq[None, :] < idle + tolerance[None, :])
+        | (scalar_lane[None, :] & (resreq[None, :] <= tolerance[None, :])),
+        axis=-1,
+    )
+    room = count < node_max_tasks
+    feasible = fit & room & sel_tol_row & active
+
+    score = node_scores(resreq[None, :], used, node_alloc, weights)[0]
+    score = jnp.where(feasible, score, -jnp.inf)
+    best = jnp.argmax(score)  # first max index — deterministic tie-break
+    ok = feasible[best]
+
+    delta = jnp.where(ok, resreq, 0.0)
+    idle = idle.at[best].add(-delta)
+    used = used.at[best].add(delta)
+    count = count.at[best].add(jnp.where(ok, 1, 0))
+    job_assigned = job_assigned.at[job_idx].add(jnp.where(ok, 1, 0))
+
+    chosen = jnp.where(ok, best, -1)
+    return _ScanState(idle, used, count, job_assigned), chosen
+
+
+@functools.partial(jax.jit, static_argnames=("weights", "gang_rounds"))
+def schedule_session(
+    task_resreq: jnp.ndarray,
+    task_job: jnp.ndarray,
+    task_sel_bits: jnp.ndarray,
+    task_tol_bits: jnp.ndarray,
+    node_idle: jnp.ndarray,
+    node_used: jnp.ndarray,
+    node_alloc: jnp.ndarray,
+    node_label_bits: jnp.ndarray,
+    node_taint_bits: jnp.ndarray,
+    node_ok: jnp.ndarray,
+    node_task_count: jnp.ndarray,
+    node_max_tasks: jnp.ndarray,
+    job_min_available: jnp.ndarray,
+    job_ready_count: jnp.ndarray,
+    tolerance: jnp.ndarray,
+    task_valid: jnp.ndarray,  # [T] bool — padding mask
+    weights: ScoreWeights = DEFAULT_WEIGHTS,
+    gang_rounds: int = 3,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Full-session kernel → (assignment[T] node index or -1, committed[T]).
+
+    Gang fixpoint: after each greedy pass, jobs with
+    assigned+ready < minAvailable are discarded (their tasks deactivated)
+    and the pass re-runs from the original state — device analogue of
+    per-job Statement.Commit/Discard.  ``gang_rounds`` bounds the cascade;
+    the host wrapper falls back to exact per-job commits when the fixpoint
+    hasn't settled.
+    """
+    # Static (state-independent) feasibility per [T, N]: labels, taints,
+    # node readiness.  Resource fit and pod-count recheck dynamically in
+    # the scan.
+    sel_ok = jnp.all(
+        (task_sel_bits[:, None, :] & ~node_label_bits[None, :, :]) == 0, axis=-1
+    )
+    tol_ok = jnp.all(
+        (node_taint_bits[None, :, :] & ~task_tol_bits[:, None, :]) == 0, axis=-1
+    )
+    static_feasible = sel_ok & tol_ok & node_ok[None, :]  # [T, N]
+
+    init = _ScanState(node_idle, node_used, node_task_count, jnp.zeros_like(job_min_available))
+
+    def one_pass(active):
+        def step(state, task):
+            return _assign_step(
+                weights, tolerance, node_alloc, node_max_tasks, state, task
+            )
+
+        final, chosen = jax.lax.scan(
+            step, init, (task_resreq, static_feasible, task_job, active)
+        )
+        return final, chosen
+
+    def round_body(carry, _):
+        active, _, _ = carry
+        final, chosen = one_pass(active)
+        ready = final.job_assigned + job_ready_count >= job_min_available
+        committed = ready[task_job] & (chosen >= 0)
+        # Discard tasks of non-ready jobs for the next round.
+        next_active = active & ready[task_job]
+        return (next_active, chosen, committed), None
+
+    carry0 = (
+        task_valid,
+        jnp.full_like(task_job, -1),
+        jnp.zeros_like(task_valid),
+    )
+    (active, chosen, committed), _ = jax.lax.scan(
+        round_body, carry0, None, length=gang_rounds
+    )
+
+    assignment = jnp.where(committed, chosen, -1)
+    return assignment, committed
+
+
+def run_packed(
+    snap: PackedSnapshot,
+    weights: ScoreWeights = DEFAULT_WEIGHTS,
+    gang_rounds: int = 3,
+) -> np.ndarray:
+    """Convenience host wrapper: PackedSnapshot → assignment[T] (np.int32)."""
+    T = snap.task_resreq.shape[0]
+    task_valid = np.zeros(T, dtype=bool)
+    task_valid[: snap.n_tasks] = True
+    assignment, _ = schedule_session(
+        jnp.asarray(snap.task_resreq),
+        jnp.asarray(snap.task_job),
+        jnp.asarray(snap.task_sel_bits),
+        jnp.asarray(snap.task_tol_bits),
+        jnp.asarray(snap.node_idle),
+        jnp.asarray(snap.node_used),
+        jnp.asarray(snap.node_alloc),
+        jnp.asarray(snap.node_label_bits),
+        jnp.asarray(snap.node_taint_bits),
+        jnp.asarray(snap.node_ok),
+        jnp.asarray(snap.node_task_count),
+        jnp.asarray(snap.node_max_tasks),
+        jnp.asarray(snap.job_min_available),
+        jnp.asarray(snap.job_ready_count),
+        jnp.asarray(snap.tolerance),
+        jnp.asarray(task_valid),
+        weights=weights,
+        gang_rounds=gang_rounds,
+    )
+    return np.asarray(assignment)[: snap.n_tasks]

@@ -1,0 +1,330 @@
+"""Snapshot → device tensor packing.
+
+This is the TPU-native replacement for the reference's snapshot marshaling
+(pkg/scheduler/cache/cache.go:712-790): instead of deep-copying Go structs,
+the session is packed into dense arrays the kernels consume.
+
+Layout (R = resource axis = [cpu_milli, memory_MiB, *scalars]; the memory
+lane is packed in MiB so float32 stays integer-exact up to 16-PiB nodes —
+byte counts above 2^24 would lose precision and break the host score
+goldens.  Non-MiB-aligned byte values round and are flagged):
+  task_resreq[T, R]   f32   task InitResreq lanes
+  task_job[T]         i32   job index per task
+  task_sel_bits[T, W] u32   required node-label bits (selector + required affinity)
+  task_tol_bits[T, W] u32   tolerated taint bits
+  node_idle[N, R]     f32   node Idle lanes
+  node_used[N, R]     f32   node Used lanes
+  node_alloc[N, R]    f32   node Allocatable lanes
+  node_label_bits[N,W]u32   node label bits
+  node_taint_bits[N,W]u32   node NoSchedule/NoExecute taint bits
+  node_ok[N]          bool  ready & schedulable
+  node_task_count[N]  i32 / node_max_tasks[N] i32
+  job_min_available[J]i32 / job_ready_count[J] i32
+
+Label/taint relational predicates become pointwise bitset ops (SURVEY §7
+"predicate expressiveness"): W words of 32 bits each; the registry assigns a
+bit per distinct (key,value) label pair / taint referenced in the session.
+Shapes are padded to buckets to avoid per-session recompiles.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from volcano_tpu.api import JobInfo, NodeInfo, TaskInfo
+from volcano_tpu.api.resource import MIN_MEMORY, MIN_MILLI_CPU, MIN_MILLI_SCALAR
+
+#: Default bitset width: 2 words = 64 distinct label pairs / taints.
+DEFAULT_BIT_WORDS = 2
+
+#: Memory lane quantization (bytes per MiB).
+MIB = float(1 << 20)
+
+
+class BitRegistry:
+    """Assigns bit indices to distinct keys; overflow falls back to host."""
+
+    def __init__(self, words: int = DEFAULT_BIT_WORDS):
+        self.words = words
+        self.index: Dict[Tuple, int] = {}
+        self.overflow = False
+
+    def bit(self, key: Tuple) -> Optional[int]:
+        idx = self.index.get(key)
+        if idx is None:
+            idx = len(self.index)
+            if idx >= self.words * 32:
+                self.overflow = True
+                return None
+            self.index[key] = idx
+        return idx
+
+    def set_bit(self, arr: np.ndarray, row: int, key: Tuple) -> None:
+        idx = self.bit(key)
+        if idx is not None:
+            arr[row, idx // 32] |= np.uint32(1 << (idx % 32))
+
+
+def _bucket(n: int, minimum: int = 64) -> int:
+    """Round up to the next power-of-two bucket to bound recompiles."""
+    if n <= minimum:
+        return minimum
+    return 1 << math.ceil(math.log2(n))
+
+
+@dataclass
+class PackedSnapshot:
+    """Dense session state (numpy host-side; moved to device by the kernel)."""
+
+    # resource axis metadata
+    resource_names: List[str] = field(default_factory=list)
+    tolerance: np.ndarray = None  # [R]
+
+    # tasks (padded to T_pad; first n_tasks valid)
+    n_tasks: int = 0
+    task_resreq: np.ndarray = None
+    task_job: np.ndarray = None
+    task_sel_bits: np.ndarray = None
+    task_tol_bits: np.ndarray = None
+
+    # nodes (padded to N_pad; first n_nodes valid)
+    n_nodes: int = 0
+    node_idle: np.ndarray = None
+    node_used: np.ndarray = None
+    node_alloc: np.ndarray = None
+    node_label_bits: np.ndarray = None
+    node_taint_bits: np.ndarray = None
+    node_ok: np.ndarray = None
+    node_task_count: np.ndarray = None
+    node_max_tasks: np.ndarray = None
+
+    # jobs (padded to J_pad; first n_jobs valid)
+    n_jobs: int = 0
+    job_min_available: np.ndarray = None
+    job_ready_count: np.ndarray = None
+
+    # host-side keys for unpacking results
+    task_uids: List[str] = field(default_factory=list)
+    node_names: List[str] = field(default_factory=list)
+    job_uids: List[str] = field(default_factory=list)
+
+    #: True when a relational predicate could not be bitset-encoded
+    #: (registry overflow or pod (anti-)affinity present).  jax-allocate
+    #: always re-validates the proposed node's predicates host-side, so
+    #: such placements degrade to fallbacks rather than wrong bindings;
+    #: standalone run_packed callers must post-validate themselves.
+    needs_host_validation: bool = False
+
+    #: False when a memory quantity was not MiB-aligned (lane rounds).
+    memory_exact: bool = True
+
+    #: [T] bool — tasks carrying preferred (anti-)affinity terms the kernel
+    #: cannot score; jax-allocate routes these to the host path.
+    task_has_preferences: np.ndarray = None
+
+    @property
+    def shape_key(self) -> Tuple[int, int, int, int, int]:
+        return (
+            self.task_resreq.shape[0],
+            self.node_idle.shape[0],
+            self.job_min_available.shape[0],
+            self.task_resreq.shape[1],
+            self.task_sel_bits.shape[1],
+        )
+
+
+def _resource_axis(
+    tasks: Sequence[TaskInfo], nodes: Sequence[NodeInfo]
+) -> Tuple[List[str], np.ndarray]:
+    scalars: List[str] = []
+    seen = set()
+    for t in tasks:
+        for name in t.init_resreq.scalars:
+            if name not in seen:
+                seen.add(name)
+                scalars.append(name)
+    for n in nodes:
+        for name in n.allocatable.scalars:
+            if name not in seen:
+                seen.add(name)
+                scalars.append(name)
+    names = ["cpu", "memory", *scalars]
+    tol = np.array(
+        [MIN_MILLI_CPU, MIN_MEMORY / MIB] + [MIN_MILLI_SCALAR] * len(scalars),
+        dtype=np.float32,
+    )
+    return names, tol
+
+
+def _res_vec(res, names: List[str], snap: "PackedSnapshot") -> np.ndarray:
+    out = np.zeros(len(names), dtype=np.float32)
+    out[0] = res.milli_cpu
+    if res.memory % MIB:
+        snap.memory_exact = False
+    out[1] = res.memory / MIB
+    for i, name in enumerate(names[2:], start=2):
+        out[i] = res.scalars.get(name, 0.0)
+    return out
+
+
+def pack_session(
+    tasks: Sequence[TaskInfo],
+    jobs: Sequence[JobInfo],
+    nodes: Sequence[NodeInfo],
+    bit_words: int = DEFAULT_BIT_WORDS,
+    pad: bool = True,
+    enforce_pod_count: bool = True,
+) -> PackedSnapshot:
+    """Pack pending tasks (in processing order), their jobs and all nodes.
+
+    ``tasks`` must arrive in the order the kernel should consider them —
+    the host computes it from the session's task/job order functions, which
+    preserves the reference's priority semantics (allocate.go:54-92).
+
+    ``enforce_pod_count`` mirrors whether the predicates plugin is in the
+    session's tiers: the pod-number limit lives there (predicates.go:164),
+    so without it the host never counts pods and neither should the kernel.
+    """
+    snap = PackedSnapshot()
+    names, tol = _resource_axis(tasks, nodes)
+    snap.resource_names = names
+    snap.tolerance = tol
+    R = len(names)
+
+    T, N, J = len(tasks), len(nodes), len(jobs)
+    T_pad = _bucket(T) if pad else max(T, 1)
+    N_pad = _bucket(N) if pad else max(N, 1)
+    J_pad = _bucket(J, minimum=16) if pad else max(J, 1)
+
+    job_index = {j.uid: i for i, j in enumerate(jobs)}
+
+    label_reg = BitRegistry(bit_words)
+    taint_reg = BitRegistry(bit_words)
+    W = bit_words
+
+    snap.n_tasks, snap.n_nodes, snap.n_jobs = T, N, J
+    snap.task_resreq = np.zeros((T_pad, R), dtype=np.float32)
+    snap.task_job = np.zeros(T_pad, dtype=np.int32)
+    snap.task_sel_bits = np.zeros((T_pad, W), dtype=np.uint32)
+    snap.task_tol_bits = np.zeros((T_pad, W), dtype=np.uint32)
+    snap.node_idle = np.zeros((N_pad, R), dtype=np.float32)
+    snap.node_used = np.zeros((N_pad, R), dtype=np.float32)
+    snap.node_alloc = np.zeros((N_pad, R), dtype=np.float32)
+    snap.node_label_bits = np.zeros((N_pad, W), dtype=np.uint32)
+    snap.node_taint_bits = np.zeros((N_pad, W), dtype=np.uint32)
+    snap.node_ok = np.zeros(N_pad, dtype=bool)
+    snap.node_task_count = np.zeros(N_pad, dtype=np.int32)
+    snap.node_max_tasks = np.zeros(N_pad, dtype=np.int32)
+    snap.job_min_available = np.zeros(J_pad, dtype=np.int32)
+    # Padded jobs get min_available high so padded tasks never commit.
+    snap.job_min_available[J:] = np.iinfo(np.int32).max
+    snap.job_ready_count = np.zeros(J_pad, dtype=np.int32)
+    snap.task_has_preferences = np.zeros(T_pad, dtype=bool)
+
+    # Tasks: selector/affinity/toleration bits come from the pod spec.
+    for i, t in enumerate(tasks):
+        snap.task_resreq[i] = _res_vec(t.init_resreq, names, snap)
+        snap.task_job[i] = job_index.get(t.job, 0)
+        snap.task_uids.append(t.uid)
+        pod = t.pod
+        if pod is None:
+            continue
+        for k, v in (pod.spec.node_selector or {}).items():
+            label_reg.set_bit(snap.task_sel_bits, i, (k, v))
+        # Required node affinity: single-term all-In expressions fold into
+        # the selector bitset; anything richer flags host validation.
+        node_aff = (pod.spec.affinity or {}).get("nodeAffinity") or {}
+        req = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+        terms = req.get("nodeSelectorTerms") or []
+        if len(terms) == 1:
+            for e in terms[0].get("matchExpressions") or []:
+                if e.get("operator", "In") == "In" and len(e.get("values") or []) == 1:
+                    label_reg.set_bit(
+                        snap.task_sel_bits, i, (e["key"], e["values"][0])
+                    )
+                else:
+                    snap.needs_host_validation = True
+        elif terms:
+            snap.needs_host_validation = True
+        for tol_ in pod.spec.tolerations or []:
+            if tol_.operator == "Exists" and not tol_.key:
+                # tolerates everything: set all taint bits
+                snap.task_tol_bits[i, :] = np.uint32(0xFFFFFFFF)
+            elif tol_.operator == "Exists":
+                pass  # keyed Exists resolved in the post-node pass below
+            else:
+                for effect in ("NoSchedule", "NoExecute"):
+                    if not tol_.effect or tol_.effect == effect:
+                        taint_reg.set_bit(
+                            snap.task_tol_bits, i, (tol_.key, tol_.value, effect)
+                        )
+        aff = pod.spec.affinity or {}
+        if aff.get("podAffinity") or aff.get("podAntiAffinity"):
+            snap.needs_host_validation = True
+        node_pref = (aff.get("nodeAffinity") or {}).get(
+            "preferredDuringSchedulingIgnoredDuringExecution"
+        )
+        pod_pref = (aff.get("podAffinity") or {}).get(
+            "preferredDuringSchedulingIgnoredDuringExecution"
+        ) or (aff.get("podAntiAffinity") or {}).get(
+            "preferredDuringSchedulingIgnoredDuringExecution"
+        )
+        if node_pref or pod_pref:
+            # Preference terms contribute to host scoring (nodeorder.py);
+            # the kernel has no lanes for them — route to host path.
+            snap.task_has_preferences[i] = True
+
+    # Nodes.
+    for i, n in enumerate(nodes):
+        snap.node_idle[i] = _res_vec(n.idle, names, snap)
+        snap.node_used[i] = _res_vec(n.used, names, snap)
+        snap.node_alloc[i] = _res_vec(n.allocatable, names, snap)
+        snap.node_ok[i] = n.ready() and not (
+            n.node is not None and n.node.spec.unschedulable
+        )
+        snap.node_task_count[i] = len(n.tasks)
+        # Host semantics: the pod-count limit is the predicates plugin's
+        # (max_task_num 0 ⇒ it rejects everything); without that plugin
+        # no limit applies.
+        snap.node_max_tasks[i] = (
+            n.allocatable.max_task_num if enforce_pod_count else np.iinfo(np.int32).max
+        )
+        snap.node_names.append(n.name)
+        if n.node is None:
+            continue
+        for k, v in (n.node.metadata.labels or {}).items():
+            # Only label pairs some task references need bits.
+            if (k, v) in label_reg.index:
+                label_reg.set_bit(snap.node_label_bits, i, (k, v))
+        for taint in n.node.spec.taints or []:
+            if taint.effect in ("NoSchedule", "NoExecute"):
+                taint_reg.set_bit(
+                    snap.node_taint_bits, i, (taint.key, taint.value, taint.effect)
+                )
+
+    # Keyed Exists tolerations need the full taint registry, which is only
+    # complete after the node pass.
+    for i, t in enumerate(tasks):
+        pod = t.pod
+        if pod is None:
+            continue
+        for tol_ in pod.spec.tolerations or []:
+            if tol_.operator == "Exists" and tol_.key:
+                for (k, v, eff), idx in taint_reg.index.items():
+                    if k == tol_.key and (not tol_.effect or tol_.effect == eff):
+                        snap.task_tol_bits[i, idx // 32] |= np.uint32(1 << (idx % 32))
+
+    # Jobs.
+    for i, j in enumerate(jobs):
+        snap.job_min_available[i] = j.min_available
+        snap.job_ready_count[i] = j.ready_task_num()
+        snap.job_uids.append(j.uid)
+
+    if label_reg.overflow or taint_reg.overflow:
+        snap.needs_host_validation = True
+
+    return snap

@@ -71,10 +71,16 @@ def predicate_nodes(
         return [], fe
     num_to_find = calculate_num_of_feasible_nodes_to_find(all_nodes)
 
+    # In deterministic mode the fairness cursor is pinned to 0 so the host
+    # path's examination order (and thus tie-breaks) matches the device
+    # kernel's lowest-index argmax.  The cursor only matters for
+    # subsampling fairness (scheduler_helper.go:84-85).
+    start = 0 if deterministic_tie_break else _last_processed_node_index
+
     found: List[NodeInfo] = []
     processed = 0
     for i in range(all_nodes):
-        node = nodes[(_last_processed_node_index + i) % all_nodes]
+        node = nodes[(start + i) % all_nodes]
         processed += 1
         try:
             fn(task, node)
@@ -85,7 +91,8 @@ def predicate_nodes(
         if len(found) >= num_to_find:
             break
 
-    _last_processed_node_index = (_last_processed_node_index + processed) % all_nodes
+    if not deterministic_tie_break:
+        _last_processed_node_index = (start + processed) % all_nodes
     return found, fe
 
 
