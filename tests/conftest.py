@@ -1,12 +1,19 @@
 """Test env: force JAX onto CPU with 8 virtual devices so multi-chip
-sharding paths are exercised without TPU hardware.  Must run before any
-module imports jax."""
+sharding paths are exercised without TPU hardware.
+
+The axon TPU plugin (when present) registers itself via sitecustomize and
+overrides JAX_PLATFORMS, so the env var alone is not enough — the config
+update after import is what actually pins the CPU backend."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

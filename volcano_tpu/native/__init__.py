@@ -1,0 +1,113 @@
+"""Native (C++) runtime components, driven via ctypes.
+
+``baseline_allocate`` is the host-native greedy allocate loop — the
+performance stand-in for the reference's Go allocate action (this
+environment has no Go toolchain; C++ with a 16-thread node sweep matches
+the reference's 16-goroutine ParallelizeUntil design,
+scheduler_helper.go:110-111).  bench.py measures it as the "stock
+reference" column.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "baseline.cpp")
+_SO = os.path.join(_HERE, "_baseline.so")
+
+_lib = None
+
+
+def _build() -> Optional[str]:
+    """Compile the shared object on demand (cached by mtime)."""
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", _SO],
+            check=True,
+            capture_output=True,
+        )
+        return _SO
+    except (OSError, subprocess.CalledProcessError):
+        return None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    so = _build()
+    if so is None:
+        return None
+    lib = ctypes.CDLL(so)
+    f32 = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    i32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    u32 = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+    u8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    lib.baseline_allocate.argtypes = [
+        f32, i32, u32, u32,              # task arrays
+        f32, f32, f32, u32, u32, u8,     # node arrays
+        i32, i32,                        # counts/max
+        i32, i32,                        # job arrays
+        f32,                             # tolerance
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
+        i32,                             # out assignment
+    ]
+    lib.baseline_allocate.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+def baseline_allocate(snap, n_threads: int = 16, gang_rounds: int = 3) -> np.ndarray:
+    """Run the native greedy allocate on a PackedSnapshot → assignment[T]."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native baseline unavailable (g++ missing?)")
+    T = snap.task_resreq.shape[0]
+    N = snap.node_idle.shape[0]
+    J = snap.job_min_available.shape[0]
+    R = snap.task_resreq.shape[1]
+    W = snap.task_sel_bits.shape[1]
+    out = np.full(T, -1, dtype=np.int32)
+
+    task_valid_rows = snap.n_tasks
+    # Padded task rows have resreq 0 and job pointing at a padded job with
+    # min_available INT32_MAX, so they never commit; the C++ loop still
+    # walks them — trim instead for speed.
+    rc = lib.baseline_allocate(
+        np.ascontiguousarray(snap.task_resreq[:task_valid_rows]),
+        np.ascontiguousarray(snap.task_job[:task_valid_rows]),
+        np.ascontiguousarray(snap.task_sel_bits[:task_valid_rows]),
+        np.ascontiguousarray(snap.task_tol_bits[:task_valid_rows]),
+        np.ascontiguousarray(snap.node_idle[: snap.n_nodes]),
+        np.ascontiguousarray(snap.node_used[: snap.n_nodes]),
+        np.ascontiguousarray(snap.node_alloc[: snap.n_nodes]),
+        np.ascontiguousarray(snap.node_label_bits[: snap.n_nodes]),
+        np.ascontiguousarray(snap.node_taint_bits[: snap.n_nodes]),
+        np.ascontiguousarray(snap.node_ok[: snap.n_nodes].astype(np.uint8)),
+        np.ascontiguousarray(snap.node_task_count[: snap.n_nodes]),
+        np.ascontiguousarray(snap.node_max_tasks[: snap.n_nodes]),
+        np.ascontiguousarray(snap.job_min_available),
+        np.ascontiguousarray(snap.job_ready_count),
+        np.ascontiguousarray(snap.tolerance),
+        task_valid_rows,
+        snap.n_nodes,
+        J,
+        R,
+        W,
+        n_threads,
+        gang_rounds,
+        out[:task_valid_rows],
+    )
+    if rc != 0:
+        raise RuntimeError(f"baseline_allocate failed: {rc}")
+    return out[:task_valid_rows]

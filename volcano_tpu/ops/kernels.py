@@ -41,6 +41,10 @@ class ScoreWeights(NamedTuple):
     ``binpack_scalar`` defaults to 0 because the host plugin skips scalar
     resources absent from its ``binpack.resources`` weight map
     (binpack.go:224-228 falls through to continue on unknown resources).
+
+    ``lr_int_exact`` switches least-requested to exact int32 division for
+    sessions with nodes beyond the f32 floor-division exactness envelope
+    (~2 TiB memory / 1600 cores); run_packed sets it from the packed data.
     """
 
     binpack_weight: float = 1.0
@@ -49,6 +53,7 @@ class ScoreWeights(NamedTuple):
     binpack_scalar: float = 0.0  # host default: unknown scalars skipped
     least_requested_weight: float = 1.0
     balanced_resource_weight: float = 1.0
+    lr_int_exact: bool = False
 
 
 DEFAULT_WEIGHTS = ScoreWeights()
@@ -123,23 +128,40 @@ def binpack_score(
 
 
 def least_requested_score(
-    task_resreq: jnp.ndarray, node_used: jnp.ndarray, node_alloc: jnp.ndarray
+    task_resreq: jnp.ndarray,
+    node_used: jnp.ndarray,
+    node_alloc: jnp.ndarray,
+    int_exact: bool = False,
 ) -> jnp.ndarray:
     """[T, N] — least_requested.go:36-53 with the reference's integer floors:
     ((cap-req)*10)//cap averaged over cpu+memory.
 
-    Computed in int32 so the floors are exact (float32 division can land a
-    hair under/over an integer and flip the floor).  Lanes are cpu-milli
-    and memory-MiB, both integer-valued and < 2^31/10 for any real node.
+    Default path: float32 floor division on integer-valued lanes
+    (cpu-milli / memory-MiB).  Exact when (cap-req)*10 < 2^24 and
+    1/cap > half-ulp(10) — node capacity below ~1.6 TiB / 1600 cores,
+    where a correctly-rounded f32 quotient cannot cross an integer
+    boundary (the true quotient is ≥ 1/cap away from any unattained
+    integer).  ``int_exact`` selects exact int32 division for larger
+    nodes (matches the host plugin for any cap < 2^31/10; integer
+    division lowers slower on TPU, hence not the default).
     """
-    req = (task_resreq[:, None, :2] + node_used[None, :, :2]).astype(jnp.int32)
-    cap = node_alloc[None, :, :2].astype(jnp.int32)
+    req = task_resreq[:, None, :2] + node_used[None, :, :2]
+    cap = node_alloc[None, :, :2]
+    if int_exact:
+        reqi = req.astype(jnp.int32)
+        capi = cap.astype(jnp.int32)
+        lane = jnp.where(
+            (capi > 0) & (reqi <= capi),
+            (capi - reqi) * jnp.int32(MAX_PRIORITY) // jnp.maximum(capi, 1),
+            0,
+        )
+        return (jnp.sum(lane, axis=-1) // 2).astype(jnp.float32)
     lane = jnp.where(
         (cap > 0) & (req <= cap),
-        (cap - req) * jnp.int32(MAX_PRIORITY) // jnp.maximum(cap, 1),
-        0,
+        jnp.floor((cap - req) * MAX_PRIORITY / jnp.maximum(cap, 1.0)),
+        0.0,
     )
-    return (jnp.sum(lane, axis=-1) // 2).astype(jnp.float32)
+    return jnp.floor(jnp.sum(lane, axis=-1) * 0.5)
 
 
 def balanced_resource_score(
@@ -170,7 +192,7 @@ def node_scores(
     (session_plugins.go:423-441)."""
     s = binpack_score(task_resreq, node_used, node_alloc, weights)
     s += weights.least_requested_weight * least_requested_score(
-        task_resreq, node_used, node_alloc
+        task_resreq, node_used, node_alloc, int_exact=weights.lr_int_exact
     )
     s += weights.balanced_resource_weight * balanced_resource_score(
         task_resreq, node_used, node_alloc
@@ -181,15 +203,51 @@ def node_scores(
 # ---- greedy assignment scan ----
 
 class _ScanState(NamedTuple):
-    node_idle: jnp.ndarray  # [N, R]
-    node_used: jnp.ndarray  # [N, R]
-    node_task_count: jnp.ndarray  # [N]
+    # used_ext packs [used lanes..., task count] so one scatter per step
+    # updates both (scatters are the dominant per-step cost at large N).
+    used_ext: jnp.ndarray  # [N, R+1]
     job_assigned: jnp.ndarray  # [J]
+
+
+def step_feasible_score(
+    weights: ScoreWeights,
+    tolerance,
+    base,  # [N, R] = idle0 + used0 (idle = base - used, no idle carry)
+    node_alloc,
+    node_max_tasks,
+    used_ext,
+    resreq,
+    feas_row,
+    active,
+):
+    """Per-step feasibility + masked score — the SINGLE copy of the
+    scheduling semantics, shared by the single-chip scan step below and
+    the sharded scan step (ops/sharded.py).  Sub-tolerance skip on scalar
+    lanes only (see predicate_mask)."""
+    used = used_ext[:, :-1]
+    count = used_ext[:, -1]
+    idle = base - used
+    scalar_lane = jnp.arange(resreq.shape[-1]) >= 2
+    fit = jnp.all(
+        (resreq[None, :] < idle + tolerance[None, :])
+        | (scalar_lane[None, :] & (resreq[None, :] <= tolerance[None, :])),
+        axis=-1,
+    )
+    feasible = fit & (count < node_max_tasks) & feas_row & active
+    score = node_scores(resreq[None, :], used, node_alloc, weights)[0]
+    return feasible, jnp.where(feasible, score, -jnp.inf)
+
+
+def step_delta_ext(resreq, ok):
+    """Packed (resource, +1 count) update row, zeroed when not placing."""
+    okf = jnp.where(ok, 1.0, 0.0)
+    return jnp.concatenate([resreq, jnp.ones((1,), resreq.dtype)]) * okf
 
 
 def _assign_step(
     weights: ScoreWeights,
     tolerance,
+    base,
     node_alloc,
     node_max_tasks,
     state: _ScanState,
@@ -201,33 +259,20 @@ def _assign_step(
     resource-fit + plugin predicates folded into the mask and
     SelectBestNode's tie-break made deterministic (first index)."""
     resreq, sel_tol_row, job_idx, active = task
-    idle, used, count, job_assigned = state
+    used_ext, job_assigned = state
 
-    # Dynamic parts of the predicate: resource fit vs *current* idle,
-    # pod-count room vs current count.  Sub-tolerance skip on scalar
-    # lanes only (see predicate_mask).
-    scalar_lane = jnp.arange(resreq.shape[-1]) >= 2
-    fit = jnp.all(
-        (resreq[None, :] < idle + tolerance[None, :])
-        | (scalar_lane[None, :] & (resreq[None, :] <= tolerance[None, :])),
-        axis=-1,
+    feasible, score = step_feasible_score(
+        weights, tolerance, base, node_alloc, node_max_tasks,
+        used_ext, resreq, sel_tol_row, active,
     )
-    room = count < node_max_tasks
-    feasible = fit & room & sel_tol_row & active
-
-    score = node_scores(resreq[None, :], used, node_alloc, weights)[0]
-    score = jnp.where(feasible, score, -jnp.inf)
     best = jnp.argmax(score)  # first max index — deterministic tie-break
     ok = feasible[best]
 
-    delta = jnp.where(ok, resreq, 0.0)
-    idle = idle.at[best].add(-delta)
-    used = used.at[best].add(delta)
-    count = count.at[best].add(jnp.where(ok, 1, 0))
+    used_ext = used_ext.at[best].add(step_delta_ext(resreq, ok))
     job_assigned = job_assigned.at[job_idx].add(jnp.where(ok, 1, 0))
 
     chosen = jnp.where(ok, best, -1)
-    return _ScanState(idle, used, count, job_assigned), chosen
+    return _ScanState(used_ext, job_assigned), chosen
 
 
 @functools.partial(jax.jit, static_argnames=("weights", "gang_rounds"))
@@ -256,9 +301,9 @@ def schedule_session(
     Gang fixpoint: after each greedy pass, jobs with
     assigned+ready < minAvailable are discarded (their tasks deactivated)
     and the pass re-runs from the original state — device analogue of
-    per-job Statement.Commit/Discard.  ``gang_rounds`` bounds the cascade;
-    the host wrapper falls back to exact per-job commits when the fixpoint
-    hasn't settled.
+    per-job Statement.Commit/Discard.  ``gang_rounds`` bounds the cascade
+    (an unsettled fixpoint ships the last round's commits, which are
+    always individually valid placements).
     """
     # Static (state-independent) feasibility per [T, N]: labels, taints,
     # node readiness.  Resource fit and pod-count recheck dynamically in
@@ -271,12 +316,16 @@ def schedule_session(
     )
     static_feasible = sel_ok & tol_ok & node_ok[None, :]  # [T, N]
 
-    init = _ScanState(node_idle, node_used, node_task_count, jnp.zeros_like(job_min_available))
+    base = node_idle + node_used
+    used_ext0 = jnp.concatenate(
+        [node_used, node_task_count.astype(node_used.dtype)[:, None]], axis=1
+    )
+    init = _ScanState(used_ext0, jnp.zeros_like(job_min_available))
 
     def one_pass(active):
         def step(state, task):
             return _assign_step(
-                weights, tolerance, node_alloc, node_max_tasks, state, task
+                weights, tolerance, base, node_alloc, node_max_tasks, state, task
             )
 
         final, chosen = jax.lax.scan(
@@ -306,33 +355,134 @@ def schedule_session(
     return assignment, committed
 
 
+@functools.partial(jax.jit, static_argnames=("weights",))
+def schedule_pass(
+    task_resreq: jnp.ndarray,
+    task_job: jnp.ndarray,
+    task_feas_class: jnp.ndarray,  # [T] index into class_sel/tol_bits
+    class_sel_bits: jnp.ndarray,  # [C, W] distinct task bitset signatures
+    class_tol_bits: jnp.ndarray,  # [C, W]
+    node_idle: jnp.ndarray,
+    node_used: jnp.ndarray,
+    node_alloc: jnp.ndarray,
+    node_label_bits: jnp.ndarray,
+    node_taint_bits: jnp.ndarray,
+    node_ok: jnp.ndarray,
+    node_task_count: jnp.ndarray,
+    node_max_tasks: jnp.ndarray,
+    job_min_available: jnp.ndarray,
+    tolerance: jnp.ndarray,
+    active: jnp.ndarray,
+    weights: ScoreWeights = DEFAULT_WEIGHTS,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One greedy pass → (chosen[T], job_assigned[J]).  The host loop in
+    run_packed applies the gang commit/discard between passes — typical
+    sessions converge after one pass instead of paying gang_rounds fixed
+    device rounds.
+
+    Static feasibility (labels/taints/readiness) is evaluated per distinct
+    bitset signature class, not per task: the scan gathers a [N] row from
+    the small [C, N] matrix instead of slicing a [T, N] one (at 50k×10k
+    that matrix is 1 GB and its per-step slice dominated the step cost)."""
+    sel_ok = jnp.all(
+        (class_sel_bits[:, None, :] & ~node_label_bits[None, :, :]) == 0, axis=-1
+    )
+    tol_ok = jnp.all(
+        (node_taint_bits[None, :, :] & ~class_tol_bits[:, None, :]) == 0, axis=-1
+    )
+    class_feasible = sel_ok & tol_ok & node_ok[None, :]  # [C, N]
+
+    base = node_idle + node_used
+    used_ext0 = jnp.concatenate(
+        [node_used, node_task_count.astype(node_used.dtype)[:, None]], axis=1
+    )
+
+    def step(state, task):
+        resreq, feas_cls, job_idx, act = task
+        return _assign_step(
+            weights,
+            tolerance,
+            base,
+            node_alloc,
+            node_max_tasks,
+            state,
+            (resreq, class_feasible[feas_cls], job_idx, act),
+        )
+
+    init = _ScanState(used_ext0, jnp.zeros_like(job_min_available))
+    final, chosen = jax.lax.scan(
+        step, init, (task_resreq, task_feas_class, task_job, active)
+    )
+    return chosen, final.job_assigned
+
+
+def _feasibility_classes(snap: PackedSnapshot):
+    """Unique (sel_bits, tol_bits) rows → (class idx per task, class bit
+    matrices)."""
+    combined = np.concatenate([snap.task_sel_bits, snap.task_tol_bits], axis=1)
+    uniq, inverse = np.unique(combined, axis=0, return_inverse=True)
+    W = snap.task_sel_bits.shape[1]
+    return (
+        inverse.astype(np.int32),
+        np.ascontiguousarray(uniq[:, :W]),
+        np.ascontiguousarray(uniq[:, W:]),
+    )
+
+
 def run_packed(
     snap: PackedSnapshot,
     weights: ScoreWeights = DEFAULT_WEIGHTS,
     gang_rounds: int = 3,
 ) -> np.ndarray:
-    """Convenience host wrapper: PackedSnapshot → assignment[T] (np.int32)."""
+    """Host wrapper: PackedSnapshot → assignment[T] (np.int32), with the
+    gang fixpoint driven host-side (adaptive: stops as soon as the active
+    set is stable, which for well-provisioned sessions is after round 1 —
+    identical outcome to the fixed-round schedule_session)."""
     T = snap.task_resreq.shape[0]
-    task_valid = np.zeros(T, dtype=bool)
-    task_valid[: snap.n_tasks] = True
-    assignment, _ = schedule_session(
-        jnp.asarray(snap.task_resreq),
-        jnp.asarray(snap.task_job),
-        jnp.asarray(snap.task_sel_bits),
-        jnp.asarray(snap.task_tol_bits),
-        jnp.asarray(snap.node_idle),
-        jnp.asarray(snap.node_used),
-        jnp.asarray(snap.node_alloc),
-        jnp.asarray(snap.node_label_bits),
-        jnp.asarray(snap.node_taint_bits),
-        jnp.asarray(snap.node_ok),
-        jnp.asarray(snap.node_task_count),
-        jnp.asarray(snap.node_max_tasks),
-        jnp.asarray(snap.job_min_available),
-        jnp.asarray(snap.job_ready_count),
-        jnp.asarray(snap.tolerance),
-        jnp.asarray(task_valid),
-        weights=weights,
-        gang_rounds=gang_rounds,
-    )
-    return np.asarray(assignment)[: snap.n_tasks]
+    active = np.zeros(T, dtype=bool)
+    active[: snap.n_tasks] = True
+
+    # Large nodes fall outside the f32 floor-division exactness envelope
+    # (see least_requested_score) — switch to exact int division.
+    if float(snap.node_alloc[:, :2].max(initial=0.0)) * MAX_PRIORITY >= 2**24:
+        weights = weights._replace(lr_int_exact=True)
+
+    task_feas_class, class_sel, class_tol = _feasibility_classes(snap)
+    dev = [
+        jnp.asarray(x)
+        for x in (
+            snap.task_resreq,
+            snap.task_job,
+            task_feas_class,
+            class_sel,
+            class_tol,
+            snap.node_idle,
+            snap.node_used,
+            snap.node_alloc,
+            snap.node_label_bits,
+            snap.node_taint_bits,
+            snap.node_ok,
+            snap.node_task_count,
+            snap.node_max_tasks,
+            snap.job_min_available,
+            snap.tolerance,
+        )
+    ]
+    task_job = snap.task_job
+    min_avail = snap.job_min_available.astype(np.int64)
+    ready_count = snap.job_ready_count.astype(np.int64)
+
+    chosen_np = np.full(T, -1, dtype=np.int32)
+    committed = np.zeros(T, dtype=bool)
+    for _ in range(gang_rounds):
+        chosen, job_assigned = schedule_pass(*dev, jnp.asarray(active), weights=weights)
+        chosen_np = np.asarray(chosen)
+        ready = np.asarray(job_assigned, dtype=np.int64) + ready_count >= min_avail
+        committed = ready[task_job] & (chosen_np >= 0)
+        next_active = active & ready[task_job]
+        if (next_active == active).all():
+            break
+        active = next_active
+
+    assignment = np.where(committed & active, chosen_np, -1)
+    return assignment[: snap.n_tasks]

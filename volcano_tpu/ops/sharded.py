@@ -1,0 +1,210 @@
+"""Multi-chip session kernel: node axis sharded over a device mesh.
+
+Scale-out design (SURVEY.md §5 "long-context" analogue): the session's
+scale axis is tasks × nodes.  Tasks are a sequential scan (allocation
+feedback), so the parallel axis is nodes — each device owns a contiguous
+node shard, evaluates predicate+score locally via the SAME
+step_feasible_score helper as the single-chip kernel, and the winner is
+reduced with one tiny all-gather of (score, local-argmax) pairs per step.
+Only O(n_devices) scalars cross ICI per step.
+
+Deterministic tie-break is preserved: each shard argmax picks its first
+(lowest-local-index) maximum, and the cross-shard reduction picks the
+lowest shard among equal maxima — together the globally lowest node index,
+identical to the single-chip kernel and the host path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from volcano_tpu.ops.kernels import (
+    DEFAULT_WEIGHTS,
+    MAX_PRIORITY,
+    ScoreWeights,
+    _feasibility_classes,
+    step_delta_ext,
+    step_feasible_score,
+)
+from volcano_tpu.ops.packing import PackedSnapshot
+
+AXIS = "nodes"
+
+
+def _sharded_kernel(
+    task_resreq,
+    task_job,
+    task_feas_class,  # [T]
+    class_sel_bits,  # [C, W] replicated
+    class_tol_bits,  # [C, W] replicated
+    node_idle,  # local shard [N_loc, R]
+    node_used,
+    node_alloc,
+    node_label_bits,
+    node_taint_bits,
+    node_ok,
+    node_task_count,
+    node_max_tasks,
+    job_min_available,
+    job_ready_count,
+    tolerance,
+    task_valid,
+    weights: ScoreWeights,
+    gang_rounds: int,
+):
+    """Body run under shard_map: node-sharded arrays are the local chunk."""
+    my_shard = jax.lax.axis_index(AXIS)
+    n_local = node_idle.shape[0]
+
+    # Class-level static feasibility against the local node shard [C, N_loc].
+    sel_ok = jnp.all(
+        (class_sel_bits[:, None, :] & ~node_label_bits[None, :, :]) == 0, axis=-1
+    )
+    tol_ok = jnp.all(
+        (node_taint_bits[None, :, :] & ~class_tol_bits[:, None, :]) == 0, axis=-1
+    )
+    class_feasible = sel_ok & tol_ok & node_ok[None, :]
+
+    base = node_idle + node_used
+    used_ext0 = jnp.concatenate(
+        [node_used, node_task_count.astype(node_used.dtype)[:, None]], axis=1
+    )
+
+    def one_pass(active):
+        def step(state, task):
+            used_ext, job_assigned = state
+            resreq, feas_cls, job_idx, act = task
+
+            feasible, score = step_feasible_score(
+                weights, tolerance, base, node_alloc, node_max_tasks,
+                used_ext, resreq, class_feasible[feas_cls], act,
+            )
+            best_local = jnp.argmax(score)
+            best_score = score[best_local]
+
+            # Cross-shard reduction: lowest shard index among max scores.
+            all_scores = jax.lax.all_gather(best_score, AXIS)  # [n_shards]
+            all_locals = jax.lax.all_gather(best_local, AXIS)
+            winner = jnp.argmax(all_scores)  # first max → lowest shard
+            ok = jnp.isfinite(all_scores[winner])
+
+            mine = (winner == my_shard) & ok
+            used_ext = used_ext.at[best_local].add(step_delta_ext(resreq, mine))
+            job_assigned = job_assigned.at[job_idx].add(jnp.where(ok, 1, 0))
+
+            chosen = jnp.where(ok, winner * n_local + all_locals[winner], -1)
+            return (used_ext, job_assigned), chosen
+
+        init = (used_ext0, jnp.zeros_like(job_min_available))
+        final, chosen = jax.lax.scan(
+            step, init, (task_resreq, task_feas_class, task_job, active)
+        )
+        return final, chosen
+
+    def round_body(carry, _):
+        active, _, _ = carry
+        final, chosen = one_pass(active)
+        ready = final[1] + job_ready_count >= job_min_available
+        committed = ready[task_job] & (chosen >= 0)
+        next_active = active & ready[task_job]
+        return (next_active, chosen, committed), None
+
+    carry0 = (task_valid, jnp.full_like(task_job, -1), jnp.zeros_like(task_valid))
+    (active, chosen, committed), _ = jax.lax.scan(
+        round_body, carry0, None, length=gang_rounds
+    )
+    assignment = jnp.where(committed, chosen, -1)
+    return assignment
+
+
+def make_sharded_session(
+    mesh: Mesh, weights: ScoreWeights = DEFAULT_WEIGHTS, gang_rounds: int = 3
+):
+    """Build the jitted node-sharded session program for ``mesh``.
+
+    Node-axis arrays are sharded over the mesh's AXIS dimension; task,
+    class and job arrays are replicated.  Returns fn(arrays…) →
+    assignment[T].
+    """
+    node_spec2 = P(AXIS, None)
+    node_spec1 = P(AXIS)
+    rep2 = P(None, None)
+    rep1 = P(None)
+
+    body = functools.partial(_sharded_kernel, weights=weights, gang_rounds=gang_rounds)
+
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            rep2,  # task_resreq
+            rep1,  # task_job
+            rep1,  # task_feas_class
+            rep2,  # class_sel_bits
+            rep2,  # class_tol_bits
+            node_spec2,  # node_idle
+            node_spec2,  # node_used
+            node_spec2,  # node_alloc
+            node_spec2,  # node_label_bits
+            node_spec2,  # node_taint_bits
+            node_spec1,  # node_ok
+            node_spec1,  # node_task_count
+            node_spec1,  # node_max_tasks
+            rep1,  # job_min_available
+            rep1,  # job_ready_count
+            rep1,  # tolerance
+            rep1,  # task_valid
+        ),
+        out_specs=rep1,
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def run_packed_sharded(
+    snap: PackedSnapshot,
+    mesh: Mesh,
+    weights: ScoreWeights = DEFAULT_WEIGHTS,
+    gang_rounds: int = 3,
+) -> np.ndarray:
+    """Host wrapper: PackedSnapshot → assignment[T] on a device mesh."""
+    n_dev = mesh.devices.size
+    N_pad = snap.node_idle.shape[0]
+    if N_pad % n_dev:
+        raise ValueError(f"padded node count {N_pad} not divisible by mesh size {n_dev}")
+
+    if float(snap.node_alloc[:, :2].max(initial=0.0)) * MAX_PRIORITY >= 2**24:
+        weights = weights._replace(lr_int_exact=True)
+
+    task_feas_class, class_sel, class_tol = _feasibility_classes(snap)
+
+    T = snap.task_resreq.shape[0]
+    task_valid = np.zeros(T, dtype=bool)
+    task_valid[: snap.n_tasks] = True
+
+    fn = make_sharded_session(mesh, weights=weights, gang_rounds=gang_rounds)
+    assignment = fn(
+        jnp.asarray(snap.task_resreq),
+        jnp.asarray(snap.task_job),
+        jnp.asarray(task_feas_class),
+        jnp.asarray(class_sel),
+        jnp.asarray(class_tol),
+        jnp.asarray(snap.node_idle),
+        jnp.asarray(snap.node_used),
+        jnp.asarray(snap.node_alloc),
+        jnp.asarray(snap.node_label_bits),
+        jnp.asarray(snap.node_taint_bits),
+        jnp.asarray(snap.node_ok),
+        jnp.asarray(snap.node_task_count),
+        jnp.asarray(snap.node_max_tasks),
+        jnp.asarray(snap.job_min_available),
+        jnp.asarray(snap.job_ready_count),
+        jnp.asarray(snap.tolerance),
+        jnp.asarray(task_valid),
+    )
+    return np.asarray(assignment)[: snap.n_tasks]
