@@ -116,10 +116,13 @@ def main() -> int:
     parser.add_argument("--all", action="store_true")
     args = parser.parse_args()
 
+    headline = "50k_pods_10k_nodes_gang_predicates"
     if args.quick:
         configs = {"1k_pods_100_nodes_binpack": BASELINE_CONFIGS["1k_pods_100_nodes_binpack"]}
     elif args.all:
-        configs = dict(BASELINE_CONFIGS)
+        # Headline config printed last → lands on stdout.
+        configs = {k: v for k, v in BASELINE_CONFIGS.items() if k != headline}
+        configs[headline] = BASELINE_CONFIGS[headline]
     else:
         configs = {args.config: BASELINE_CONFIGS[args.config]}
 

@@ -1,0 +1,227 @@
+"""Admission webhook + CLI tests (reference: admit_job_test.go,
+mutate_job_test.go, pkg/cli tests)."""
+
+from __future__ import annotations
+
+import io
+
+import pytest
+
+from volcano_tpu.admission import mutate_job, register_webhooks, validate_job
+from volcano_tpu.admission.pods import validate_pod
+from volcano_tpu.apis import batch, core, scheduling
+from volcano_tpu.cli import main as vtctl
+from volcano_tpu.client import AdmissionError, APIServer, KubeClient, VolcanoClient
+
+
+def base_job(**spec_kw):
+    defaults = dict(
+        min_available=1,
+        tasks=[
+            batch.TaskSpec(
+                name="worker",
+                replicas=1,
+                template=core.PodTemplateSpec(spec=core.PodSpec(containers=[core.Container()])),
+            )
+        ],
+    )
+    defaults.update(spec_kw)
+    return batch.Job(
+        metadata=core.ObjectMeta(name="j", namespace="ns"),
+        spec=batch.JobSpec(**defaults),
+    )
+
+
+class TestValidateJob:
+    def test_valid_job_passes(self):
+        validate_job(base_job())
+
+    def test_min_available_zero_denied(self):
+        with pytest.raises(AdmissionError, match="minAvailable"):
+            validate_job(base_job(min_available=0))
+
+    def test_negative_max_retry_denied(self):
+        with pytest.raises(AdmissionError, match="maxRetry"):
+            validate_job(base_job(max_retry=-1))
+
+    def test_no_tasks_denied(self):
+        with pytest.raises(AdmissionError, match="No task specified"):
+            validate_job(base_job(tasks=[]))
+
+    def test_duplicate_task_names_denied(self):
+        job = base_job()
+        job.spec.tasks.append(job.spec.tasks[0])
+        with pytest.raises(AdmissionError, match="duplicated task name"):
+            validate_job(job)
+
+    def test_invalid_dns_name_denied(self):
+        job = base_job()
+        job.spec.tasks[0].name = "Invalid_Name"
+        with pytest.raises(AdmissionError, match="DNS-1123"):
+            validate_job(job)
+
+    def test_min_available_exceeds_replicas_denied(self):
+        with pytest.raises(AdmissionError, match="total replicas"):
+            validate_job(base_job(min_available=5))
+
+    def test_bad_policy_event_denied(self):
+        job = base_job(policies=[batch.LifecyclePolicy(event="NoSuchEvent", action=batch.RESTART_JOB_ACTION)])
+        with pytest.raises(AdmissionError, match="invalid event"):
+            validate_job(job)
+
+    def test_exit_code_zero_denied(self):
+        job = base_job(policies=[batch.LifecyclePolicy(exit_code=0, action=batch.ABORT_JOB_ACTION)])
+        with pytest.raises(AdmissionError, match="not a valid error code"):
+            validate_job(job)
+
+    def test_unknown_plugin_denied(self):
+        with pytest.raises(AdmissionError, match="unable to find job plugin"):
+            validate_job(base_job(plugins={"nope": []}))
+
+    def test_missing_queue_denied(self):
+        api = APIServer()
+        with pytest.raises(AdmissionError, match="unable to find job queue"):
+            validate_job(base_job(queue="ghost"), api)
+
+    def test_existing_queue_allowed(self):
+        api = APIServer()
+        VolcanoClient(api).create_queue(
+            scheduling.Queue(metadata=core.ObjectMeta(name="default", namespace=""))
+        )
+        validate_job(base_job(), api)
+
+
+class TestMutateJob:
+    def test_defaults_queue_and_task_names(self):
+        job = base_job()
+        job.spec.queue = ""
+        job.spec.tasks[0].name = ""
+        mutate_job(job)
+        assert job.spec.queue == "default"
+        assert job.spec.tasks[0].name == "default0"
+
+
+class TestPodGate:
+    def test_pod_blocked_until_podgroup_inqueue(self):
+        api = APIServer()
+        vc = VolcanoClient(api)
+        pod = core.Pod(
+            metadata=core.ObjectMeta(
+                name="p", namespace="ns",
+                annotations={scheduling.GROUP_NAME_ANNOTATION_KEY: "pg1"},
+            ),
+            spec=core.PodSpec(scheduler_name="volcano-tpu"),
+        )
+        with pytest.raises(AdmissionError, match="cannot find PodGroup"):
+            validate_pod(pod, api)
+        vc.create_pod_group(
+            scheduling.PodGroup(
+                metadata=core.ObjectMeta(name="pg1", namespace="ns"),
+                status=scheduling.PodGroupStatus(phase=scheduling.POD_GROUP_PENDING),
+            )
+        )
+        with pytest.raises(AdmissionError, match="is Pending"):
+            validate_pod(pod, api)
+        pg = vc.get_pod_group("ns", "pg1")
+        pg.status.phase = scheduling.POD_GROUP_INQUEUE
+        vc.update_pod_group(pg)
+        validate_pod(pod, api)  # allowed now
+
+    def test_foreign_scheduler_pod_allowed(self):
+        pod = core.Pod(spec=core.PodSpec(scheduler_name="default-scheduler"))
+        validate_pod(pod, APIServer())
+
+
+class TestRegisteredWebhooks:
+    def test_create_invalid_job_through_api_denied(self):
+        api = APIServer()
+        register_webhooks(api)
+        vc = VolcanoClient(api)
+        vc.create_queue(scheduling.Queue(metadata=core.ObjectMeta(name="default", namespace="")))
+        with pytest.raises(AdmissionError):
+            vc.create_job(base_job(min_available=0))
+        # valid one mutates defaults in
+        job = base_job()
+        job.spec.tasks[0].name = ""
+        created = vc.create_job(job)
+        assert created.spec.tasks[0].name == "default0"
+
+
+class TestCLI:
+    def _api(self):
+        api = APIServer()
+        register_webhooks(api)
+        VolcanoClient(api).create_queue(
+            scheduling.Queue(metadata=core.ObjectMeta(name="default", namespace=""))
+        )
+        return api
+
+    def test_job_run_list_view_delete(self):
+        api = self._api()
+        out = io.StringIO()
+        assert vtctl(["job", "run", "-N", "myjob", "-r", "2", "--min", "1"], api, out) == 0
+        assert "run job myjob successfully" in out.getvalue()
+
+        out = io.StringIO()
+        assert vtctl(["job", "list"], api, out) == 0
+        assert "myjob" in out.getvalue()
+
+        out = io.StringIO()
+        assert vtctl(["job", "view", "-N", "myjob"], api, out) == 0
+        assert "minAvailable" in out.getvalue()
+
+        out = io.StringIO()
+        assert vtctl(["job", "delete", "-N", "myjob"], api, out) == 0
+        assert VolcanoClient(api).list_jobs() == []
+
+    def test_job_suspend_emits_command(self):
+        api = self._api()
+        out = io.StringIO()
+        vtctl(["job", "run", "-N", "j1"], api, out)
+        assert vtctl(["job", "suspend", "-N", "j1"], api, out) == 0
+        cmds = VolcanoClient(api).list_commands()
+        assert len(cmds) == 1 and cmds[0].action == batch.ABORT_JOB_ACTION
+
+    def test_queue_lifecycle(self):
+        api = self._api()
+        out = io.StringIO()
+        assert vtctl(["queue", "create", "-N", "q1", "-w", "5"], api, out) == 0
+        out = io.StringIO()
+        assert vtctl(["queue", "get", "-N", "q1"], api, out) == 0
+        assert "q1" in out.getvalue()
+        out = io.StringIO()
+        assert vtctl(["queue", "operate", "-N", "q1", "-a", "close"], api, out) == 0
+        cmds = VolcanoClient(api).list_commands()
+        assert any(c.action == "CloseQueue" for c in cmds)
+        out = io.StringIO()
+        assert vtctl(["queue", "delete", "-N", "q1"], api, out) == 0
+
+    def test_job_run_from_yaml(self, tmp_path):
+        api = self._api()
+        yaml_file = tmp_path / "job.yaml"
+        yaml_file.write_text(
+            """
+apiVersion: batch.volcano-tpu.io/v1alpha1
+kind: Job
+metadata:
+  name: yamljob
+  namespace: default
+spec:
+  minAvailable: 2
+  tasks:
+  - name: worker
+    replicas: 2
+    template:
+      spec:
+        containers:
+        - name: main
+          image: busybox
+          resources:
+            requests:
+              cpu: "1"
+"""
+        )
+        out = io.StringIO()
+        assert vtctl(["job", "run", "-f", str(yaml_file)], api, out) == 0
+        job = VolcanoClient(api).get_job("default", "yamljob")
+        assert job is not None and job.spec.min_available == 2

@@ -1,0 +1,236 @@
+"""End-to-end: the full control loop in one process.
+
+The reference proves integration on a kind cluster (hack/run-e2e-kind.sh +
+test/e2e suites); the standalone equivalent wires every component through
+the in-process API server: admission webhooks → job controller → podgroup/
+queue controllers → scheduler (cache + session + actions) → binder → fake
+kubelet → pod phases → lifecycle policies → job completion.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from volcano_tpu.admission import register_webhooks
+from volcano_tpu.apis import batch, core, scheduling
+from volcano_tpu.cli import main as vtctl
+from volcano_tpu.client import ADDED, APIServer, KubeClient, MODIFIED, SchedulerClient, VolcanoClient
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.controllers import (
+    GarbageCollector,
+    JobController,
+    PodGroupController,
+    QueueController,
+)
+from volcano_tpu.scheduler.scheduler import Scheduler
+
+from tests.builders import build_node
+
+
+class FakeKubelet:
+    """Runs bound pods: Pending+node → Running.  Completion is driven by
+    tests via succeed()/fail() (the e2e suites' pod-kill analogue)."""
+
+    def __init__(self, api: APIServer):
+        self.api = api
+        self.kube = KubeClient(api)
+        self._pending = []
+        api.watch("Pod", self._on_pod)
+
+    def _on_pod(self, event, old, new) -> None:
+        if event in (ADDED, MODIFIED) and new is not None:
+            if new.spec.node_name and new.status.phase == "Pending":
+                self._pending.append((new.metadata.namespace, new.metadata.name))
+
+    def drain(self) -> None:
+        while self._pending:
+            namespace, name = self._pending.pop()
+            pod = self.kube.get_pod(namespace, name)
+            if pod is not None and pod.spec.node_name and pod.status.phase == "Pending":
+                pod.status.phase = "Running"
+                self.kube.update_pod_status(pod)
+
+    def finish(self, namespace: str, name: str, phase: str = "Succeeded", exit_code=None) -> None:
+        pod = self.kube.get_pod(namespace, name)
+        pod.status.phase = phase
+        pod.status.exit_code = exit_code
+        self.kube.update_pod_status(pod)
+
+
+class Cluster:
+    """All binaries in one harness."""
+
+    def __init__(self, nodes=3, node_cpu="8", node_mem="16Gi", gate_pods=False):
+        self.api = APIServer()
+        register_webhooks(self.api, gate_pods=gate_pods)
+        self.kube = KubeClient(self.api)
+        self.vc = VolcanoClient(self.api)
+
+        for i in range(nodes):
+            self.kube.create_node(build_node(f"node-{i}", {"cpu": node_cpu, "memory": node_mem}))
+        self.vc.create_queue(
+            scheduling.Queue(metadata=core.ObjectMeta(name="default", namespace=""))
+        )
+
+        self.job_controller = JobController(self.api)
+        self.queue_controller = QueueController(self.api)
+        self.podgroup_controller = PodGroupController(self.api)
+        self.gc = GarbageCollector(self.api)
+        self.kubelet = FakeKubelet(self.api)
+
+        client = SchedulerClient(self.api)
+        self.cache = SchedulerCache(client=client, scheduler_name="volcano-tpu")
+        self.scheduler = Scheduler(self.cache)
+        self.cache.run()
+
+    def tick(self, rounds: int = 3) -> None:
+        """One converging settle: controllers → scheduler → kubelet."""
+        for _ in range(rounds):
+            self.job_controller.drain()
+            self.podgroup_controller.drain()
+            self.scheduler.run_once()
+            self.kubelet.drain()
+            self.queue_controller.drain()
+        self.job_controller.drain()
+
+
+def submit(cluster: Cluster, name="e2e-job", replicas=3, min_available=3, **spec_kw):
+    task = batch.TaskSpec(
+        name="worker",
+        replicas=replicas,
+        template=core.PodTemplateSpec(
+            spec=core.PodSpec(
+                containers=[core.Container(resources={"requests": {"cpu": "1", "memory": "1Gi"}})]
+            )
+        ),
+    )
+    job = batch.Job(
+        metadata=core.ObjectMeta(name=name, namespace="default"),
+        spec=batch.JobSpec(min_available=min_available, tasks=[task], **spec_kw),
+    )
+    return cluster.vc.create_job(job)
+
+
+class TestE2EJobLifecycle:
+    def test_job_schedules_and_runs(self):
+        """test/e2e job_scheduling.go 'schedule job when resources are enough'."""
+        cluster = Cluster()
+        submit(cluster)
+        cluster.tick()
+
+        job = cluster.vc.get_job("default", "e2e-job")
+        assert job.status.state.phase == batch.JOB_RUNNING
+        assert job.status.running == 3
+        pods = cluster.kube.list_pods("default")
+        assert all(p.spec.node_name for p in pods)
+        pg = cluster.vc.get_pod_group("default", "e2e-job")
+        assert pg.status.phase == scheduling.POD_GROUP_RUNNING
+
+    def test_gang_job_stays_pending_when_oversized(self):
+        """job_scheduling.go gang cases: nothing binds when the gang
+        can't fit."""
+        cluster = Cluster(nodes=1, node_cpu="2")
+        submit(cluster, replicas=4, min_available=4)
+        cluster.tick()
+
+        job = cluster.vc.get_job("default", "e2e-job")
+        assert job.status.state.phase == batch.JOB_PENDING
+        pods = cluster.kube.list_pods("default")
+        assert all(not p.spec.node_name for p in pods)
+        pg = cluster.vc.get_pod_group("default", "e2e-job")
+        conds = [c for c in pg.status.conditions if c.type == "Unschedulable"]
+        assert conds and "gang" in conds[0].message
+
+    def test_job_completes_and_gc_reaps(self):
+        """job_lifecycle.go completion + TTL."""
+        cluster = Cluster()
+        submit(cluster, name="done-job", ttl_seconds_after_finished=0)
+        cluster.tick()
+        for i in range(3):
+            cluster.kubelet.finish("default", f"done-job-worker-{i}")
+        cluster.tick()
+        job = cluster.vc.get_job("default", "done-job")
+        assert job.status.state.phase == batch.JOB_COMPLETED
+        assert cluster.gc.process_expired() == 1
+        assert cluster.vc.get_job("default", "done-job") is None
+
+    def test_pod_failure_restart_policy(self):
+        """job_error_handling.go 'restart job when pod is failed'."""
+        cluster = Cluster()
+        submit(
+            cluster,
+            name="flaky",
+            policies=[
+                batch.LifecyclePolicy(event=batch.POD_FAILED_EVENT, action=batch.RESTART_JOB_ACTION)
+            ],
+        )
+        cluster.tick()
+        cluster.kubelet.finish("default", "flaky-worker-1", phase="Failed", exit_code=137)
+        cluster.tick(rounds=4)
+        job = cluster.vc.get_job("default", "flaky")
+        assert job.status.retry_count >= 1
+        # job recovered: pods recreated and running again
+        assert job.status.state.phase == batch.JOB_RUNNING
+
+    def test_suspend_resume_via_cli(self):
+        """command.go suspend/resume through vcctl-equivalent."""
+        cluster = Cluster()
+        submit(cluster, name="pausable")
+        cluster.tick()
+        assert vtctl(["job", "suspend", "-N", "pausable", "-n", "default"], cluster.api) == 0
+        cluster.tick()
+        job = cluster.vc.get_job("default", "pausable")
+        assert job.status.state.phase in (batch.JOB_ABORTING, batch.JOB_ABORTED)
+
+        assert vtctl(["job", "resume", "-N", "pausable", "-n", "default"], cluster.api) == 0
+        cluster.tick(rounds=4)
+        job = cluster.vc.get_job("default", "pausable")
+        assert job.status.state.phase == batch.JOB_RUNNING
+
+    def test_fair_share_between_queues(self):
+        """job_scheduling.go proportion cases: two queues with 1:1 weight
+        split a saturated cluster evenly."""
+        cluster = Cluster(nodes=2, node_cpu="4", node_mem="16Gi")
+        for qname in ("qa", "qb"):
+            cluster.vc.create_queue(
+                scheduling.Queue(metadata=core.ObjectMeta(name=qname, namespace=""))
+            )
+        # 8 cpu total; each queue requests 8 → deserved 4 each.
+        submit(cluster, name="job-a", replicas=8, min_available=1, queue="qa")
+        submit(cluster, name="job-b", replicas=8, min_available=1, queue="qb")
+        cluster.tick(rounds=5)
+        ja = cluster.vc.get_job("default", "job-a")
+        jb = cluster.vc.get_job("default", "job-b")
+        assert ja.status.running == 4
+        assert jb.status.running == 4
+
+    def test_delay_pod_creation_gate(self):
+        """admission.go + delay-pod-creation design: with the pod gate on,
+        pods stay uncreated until enqueue moves the PodGroup to Inqueue
+        (driven by minResources alone), then the job runs normally."""
+        cluster = Cluster(gate_pods=True)
+        submit(cluster, name="gated")
+        cluster.job_controller.drain()
+        assert cluster.kube.list_pods("default") == []  # gated while PG Pending
+        cluster.tick(rounds=4)
+        job = cluster.vc.get_job("default", "gated")
+        assert job.status.state.phase == batch.JOB_RUNNING
+        assert job.status.running == 3
+
+    def test_normal_pod_gets_podgroup(self):
+        """pg_controller.go: a plain pod using our scheduler gets an
+        auto-created singleton PodGroup and schedules."""
+        cluster = Cluster()
+        pod = core.Pod(
+            metadata=core.ObjectMeta(name="loner", namespace="default", uid="uid-loner"),
+            spec=core.PodSpec(
+                scheduler_name="volcano-tpu",
+                containers=[core.Container(resources={"requests": {"cpu": "1"}})],
+            ),
+        )
+        cluster.kube.create_pod(pod)
+        cluster.tick()
+        pg = cluster.vc.get_pod_group("default", "podgroup-uid-loner")
+        assert pg is not None and pg.spec.min_member == 1
+        stored = cluster.kube.get_pod("default", "loner")
+        assert stored.spec.node_name  # scheduled as a gang of one

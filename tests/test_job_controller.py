@@ -1,0 +1,258 @@
+"""Job controller tests — reconcile loop, state machine, lifecycle
+policies, plugins.  Mirrors the reference pattern (job_state_test.go,
+job_controller_actions_test.go): fake clientset == in-process API server,
+direct drain() instead of background workers."""
+
+from __future__ import annotations
+
+import pytest
+
+from volcano_tpu.apis import batch, bus, core
+from volcano_tpu.client import APIServer, KubeClient, VolcanoClient
+from volcano_tpu.controllers import GarbageCollector, JobController, QueueController
+
+
+def make_job(name="job1", namespace="ns", replicas=3, min_available=3, **spec_kw):
+    task = batch.TaskSpec(
+        name="worker",
+        replicas=replicas,
+        template=core.PodTemplateSpec(
+            spec=core.PodSpec(
+                containers=[core.Container(resources={"requests": {"cpu": "1", "memory": "1Gi"}})]
+            )
+        ),
+    )
+    return batch.Job(
+        metadata=core.ObjectMeta(name=name, namespace=namespace, uid=f"uid-{name}"),
+        spec=batch.JobSpec(min_available=min_available, tasks=[task], **spec_kw),
+    )
+
+
+@pytest.fixture
+def env():
+    api = APIServer()
+    jc = JobController(api)
+    return api, jc, KubeClient(api), VolcanoClient(api)
+
+
+def set_pod_phase(kube, namespace, name, phase, exit_code=None):
+    pod = kube.get_pod(namespace, name)
+    pod.status.phase = phase
+    pod.status.exit_code = exit_code
+    kube.update_pod_status(pod)
+
+
+class TestSyncJob:
+    def test_create_job_fans_out_pods_and_podgroup(self, env):
+        api, jc, kube, vc = env
+        vc.create_job(make_job())
+        jc.drain()
+
+        pods = kube.list_pods("ns")
+        assert {p.metadata.name for p in pods} == {
+            "job1-worker-0", "job1-worker-1", "job1-worker-2"
+        }
+        # identity annotations (job_controller_util.go:102-105)
+        pod = pods[0]
+        assert pod.metadata.annotations[batch.JOB_NAME_KEY] == "job1"
+        assert pod.metadata.annotations[batch.TASK_SPEC_KEY] == "worker"
+        pg = vc.get_pod_group("ns", "job1")
+        assert pg is not None
+        assert pg.spec.min_member == 3
+        assert pg.spec.min_resources["cpu"] == "3000m"
+        job = vc.get_job("ns", "job1")
+        assert job.status.state.phase == batch.JOB_PENDING
+        assert job.status.pending == 3
+
+    def test_pending_to_running_when_min_available_active(self, env):
+        api, jc, kube, vc = env
+        vc.create_job(make_job())
+        jc.drain()
+        for i in range(3):
+            set_pod_phase(kube, "ns", f"job1-worker-{i}", "Running")
+        jc.drain()
+        job = vc.get_job("ns", "job1")
+        assert job.status.state.phase == batch.JOB_RUNNING
+        assert job.status.running == 3
+
+    def test_running_to_completed_when_all_finish(self, env):
+        api, jc, kube, vc = env
+        vc.create_job(make_job())
+        jc.drain()
+        for i in range(3):
+            set_pod_phase(kube, "ns", f"job1-worker-{i}", "Running")
+        jc.drain()
+        for i in range(3):
+            set_pod_phase(kube, "ns", f"job1-worker-{i}", "Succeeded")
+        jc.drain()
+        job = vc.get_job("ns", "job1")
+        assert job.status.state.phase == batch.JOB_COMPLETED
+        assert job.status.succeeded == 3
+        # podgroup deleted by the kill in finished state
+        assert vc.get_pod_group("ns", "job1") is None
+
+
+class TestLifecyclePolicies:
+    def test_pod_failed_restart_policy(self, env):
+        api, jc, kube, vc = env
+        job = make_job(
+            policies=[batch.LifecyclePolicy(event=batch.POD_FAILED_EVENT, action=batch.RESTART_JOB_ACTION)]
+        )
+        vc.create_job(job)
+        jc.drain()
+        for i in range(3):
+            set_pod_phase(kube, "ns", f"job1-worker-{i}", "Running")
+        jc.drain()
+        set_pod_phase(kube, "ns", "job1-worker-1", "Failed")
+        jc.drain()
+        stored = vc.get_job("ns", "job1")
+        # RestartJob: kill (version bump, retry count) then back through
+        # Restarting → Pending → pods recreated.
+        assert stored.status.retry_count >= 1
+        assert stored.status.version >= 1
+        assert stored.status.state.phase in (batch.JOB_RESTARTING, batch.JOB_PENDING, batch.JOB_RUNNING)
+        # eventually pods exist again
+        assert len(kube.list_pods("ns")) == 3
+
+    def test_abort_action_via_command(self, env):
+        api, jc, kube, vc = env
+        vc.create_job(make_job())
+        jc.drain()
+        vc.create_command(
+            bus.Command(
+                metadata=core.ObjectMeta(name="cmd1", namespace="ns"),
+                action=batch.ABORT_JOB_ACTION,
+                target_object=core.OwnerReference(kind="Job", name="job1"),
+            )
+        )
+        jc.drain()
+        job = vc.get_job("ns", "job1")
+        assert job.status.state.phase in (batch.JOB_ABORTING, batch.JOB_ABORTED)
+        # command consumed
+        assert vc.list_commands("ns") == []
+        # pending pods killed (retain-soft keeps none since all Pending)
+        assert kube.list_pods("ns") == []
+
+    def test_stale_pod_event_fenced_by_version(self, env):
+        api, jc, kube, vc = env
+        job = make_job(
+            policies=[batch.LifecyclePolicy(event=batch.POD_FAILED_EVENT, action=batch.ABORT_JOB_ACTION)]
+        )
+        vc.create_job(job)
+        jc.drain()
+        from volcano_tpu.controllers.apis import Request
+        from volcano_tpu.controllers.job.job_controller import apply_policies
+
+        stored = vc.get_job("ns", "job1")
+        stored.status.version = 5
+        # stale event carries version 2 < 5 → SyncJob, not Abort
+        req = Request(namespace="ns", job_name="job1", event=batch.POD_FAILED_EVENT, job_version=2)
+        assert apply_policies(stored, req) == batch.SYNC_JOB_ACTION
+
+    def test_task_level_policy_overrides_job_level(self, env):
+        api, jc, kube, vc = env
+        job = make_job()
+        job.spec.tasks[0].policies = [
+            batch.LifecyclePolicy(event=batch.POD_FAILED_EVENT, action=batch.RESTART_TASK_ACTION)
+        ]
+        job.spec.policies = [
+            batch.LifecyclePolicy(event=batch.POD_FAILED_EVENT, action=batch.ABORT_JOB_ACTION)
+        ]
+        from volcano_tpu.controllers.apis import Request
+        from volcano_tpu.controllers.job.job_controller import apply_policies
+
+        req = Request(
+            namespace="ns", job_name="job1", task_name="worker", event=batch.POD_FAILED_EVENT
+        )
+        assert apply_policies(job, req) == batch.RESTART_TASK_ACTION
+
+
+class TestJobPlugins:
+    def test_svc_and_ssh_and_env_plugins(self, env):
+        api, jc, kube, vc = env
+        job = make_job(plugins={"env": [], "ssh": [], "svc": []})
+        vc.create_job(job)
+        jc.drain()
+
+        # svc: headless service + hosts configmap
+        svc = kube.get_service("ns", "job1")
+        assert svc is not None and svc.spec.cluster_ip == "None"
+        cm = kube.get_config_map("ns", "job1-svc")
+        assert "job1-worker-0.job1" in cm.data["VC_TASK_HOSTS"]
+        # ssh: keypair secret
+        secret = kube.get_secret("ns", "job1-ssh")
+        assert secret is not None and "id_rsa" in secret.data
+        # env + mounts on pods
+        pod = kube.get_pod("ns", "job1-worker-1")
+        envs = {e.name: e.value for e in pod.spec.containers[0].env}
+        assert envs["VK_TASK_INDEX"] == "1"
+        assert pod.spec.hostname == "job1-worker-1"
+        assert pod.spec.subdomain == "job1"
+        mounts = [m.mount_path for m in pod.spec.containers[0].volume_mounts]
+        assert "/root/.ssh" in mounts and "/etc/volcano" in mounts
+
+
+class TestQueueController:
+    def test_close_open_via_command(self, env):
+        api, jc, kube, vc = env
+        from volcano_tpu.apis import scheduling
+
+        qc = QueueController(api)
+        vc.create_queue(scheduling.Queue(metadata=core.ObjectMeta(name="q1", namespace="")))
+        qc.drain()
+        assert vc.get_queue("q1").status.state == scheduling.QUEUE_STATE_OPEN
+
+        vc.create_command(
+            bus.Command(
+                metadata=core.ObjectMeta(name="close-q1", namespace=""),
+                action="CloseQueue",
+                target_object=core.OwnerReference(kind="Queue", name="q1"),
+            )
+        )
+        qc.drain()
+        q = vc.get_queue("q1")
+        assert q.status.state == scheduling.QUEUE_STATE_CLOSED  # no podgroups → straight to Closed
+
+        vc.create_command(
+            bus.Command(
+                metadata=core.ObjectMeta(name="open-q1", namespace=""),
+                action="OpenQueue",
+                target_object=core.OwnerReference(kind="Queue", name="q1"),
+            )
+        )
+        qc.drain()
+        assert vc.get_queue("q1").status.state == scheduling.QUEUE_STATE_OPEN
+
+    def test_podgroup_counts(self, env):
+        api, jc, kube, vc = env
+        from volcano_tpu.apis import scheduling
+
+        qc = QueueController(api)
+        vc.create_queue(scheduling.Queue(metadata=core.ObjectMeta(name="q2", namespace="")))
+        vc.create_job(make_job(name="jq", min_available=1, queue="q2"))
+        jc.drain()
+        qc.drain()
+        q = vc.get_queue("q2")
+        assert q.status.pending == 1
+
+
+class TestGarbageCollector:
+    def test_ttl_reaps_finished_job(self, env):
+        import time as _time
+
+        api, jc, kube, vc = env
+        # Fake clock anchored to real time: state transition timestamps
+        # come from time.time() inside the controller.
+        now = [_time.time()]
+        gc = GarbageCollector(api, clock=lambda: now[0])
+        job = make_job(name="short", ttl_seconds_after_finished=10)
+        vc.create_job(job)
+        jc.drain()
+        for i in range(3):
+            set_pod_phase(kube, "ns", f"short-worker-{i}", "Succeeded")
+        jc.drain()
+        assert vc.get_job("ns", "short").status.state.phase == batch.JOB_COMPLETED
+        assert gc.process_expired() == 0  # TTL not reached
+        now[0] += 1e6
+        assert gc.process_expired() == 1
+        assert vc.get_job("ns", "short") is None

@@ -1,0 +1,13 @@
+"""Admission webhooks: job validation/mutation + pod creation gate.
+
+Reference: pkg/admission — jobs/validate/admit_job.go, jobs/mutate/
+mutate_job.go, pods/admit_pod.go, wired through the router into the API
+server (here: registered as in-process admission hooks, the standalone
+equivalent of webhook configurations with CA bundles).
+"""
+
+from volcano_tpu.admission.jobs import DEFAULT_QUEUE, mutate_job, validate_job
+from volcano_tpu.admission.pods import validate_pod
+from volcano_tpu.admission.server import register_webhooks
+
+__all__ = ["mutate_job", "validate_job", "validate_pod", "register_webhooks"]

@@ -1,0 +1,123 @@
+"""Job admission: defaulting mutation + deep validation.
+
+Reference: pkg/admission/jobs/mutate/mutate_job.go:105-143 and
+jobs/validate/admit_job.go:103-258.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from volcano_tpu.apis import batch
+from volcano_tpu.client.apiserver import AdmissionError, APIServer
+
+DEFAULT_QUEUE = "default"
+
+_DNS1123_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+_DNS1123_MAX = 63
+
+
+def is_dns1123_label(name: str) -> bool:
+    return len(name) <= _DNS1123_MAX and bool(_DNS1123_RE.match(name))
+
+
+def mutate_job(job: batch.Job) -> batch.Job:
+    """Defaulting patch: queue="default", task name=default<idx>
+    (mutate_job.go:105-143)."""
+    if not job.spec.queue:
+        job.spec.queue = DEFAULT_QUEUE
+    for index, task in enumerate(job.spec.tasks):
+        if not task.name:
+            task.name = f"{batch.DEFAULT_TASK_SPEC}{index}"
+    return job
+
+
+def _validate_policies(policies: List[batch.LifecyclePolicy], path: str) -> List[str]:
+    """admit_job.go validatePolicies: event/action legality, event+events
+    exclusivity, duplicates, exit code rules."""
+    msgs: List[str] = []
+    events_seen = set()
+    exit_codes_seen = set()
+    for policy in policies:
+        if policy.event and policy.events:
+            msgs.append(f"{path}: both event and events are specified")
+        for event in [policy.event, *policy.events]:
+            if event and event not in batch.VALID_EVENTS:
+                msgs.append(f"{path}: invalid event {event}")
+            if event:
+                if event in events_seen:
+                    msgs.append(f"{path}: duplicate event {event}")
+                events_seen.add(event)
+        if policy.action and policy.action not in batch.VALID_ACTIONS:
+            msgs.append(f"{path}: invalid action {policy.action}")
+        if policy.exit_code is not None:
+            if policy.exit_code == 0:
+                msgs.append(f"{path}: 0 is not a valid error code")
+            if policy.exit_code in exit_codes_seen:
+                msgs.append(f"{path}: duplicate exitCode {policy.exit_code}")
+            exit_codes_seen.add(policy.exit_code)
+        if not policy.event and not policy.events and policy.exit_code is None:
+            msgs.append(f"{path}: either event(s) or exitCode must be specified")
+    return msgs
+
+
+def validate_job(job: batch.Job, api: Optional[APIServer] = None) -> None:
+    """admit_job.go:103-192 — raises AdmissionError on the first deny."""
+    if job.spec.min_available <= 0:
+        raise AdmissionError("'minAvailable' must be greater than zero.")
+    if job.spec.max_retry < 0:
+        raise AdmissionError("'maxRetry' cannot be less than zero.")
+    if (
+        job.spec.ttl_seconds_after_finished is not None
+        and job.spec.ttl_seconds_after_finished < 0
+    ):
+        raise AdmissionError("'ttlSecondsAfterFinished' cannot be less than zero.")
+    if not job.spec.tasks:
+        raise AdmissionError("No task specified in job spec")
+
+    msgs: List[str] = []
+    task_names = set()
+    total_replicas = 0
+    for index, task in enumerate(job.spec.tasks):
+        if task.replicas <= 0:
+            msgs.append(f"'replicas' is not set positive in task: {task.name};")
+        total_replicas += max(task.replicas, 0)
+        if not is_dns1123_label(task.name):
+            msgs.append(f"task name {task.name!r} must be a valid DNS-1123 label;")
+        if task.name in task_names:
+            msgs.append(f"duplicated task name {task.name};")
+            break
+        task_names.add(task.name)
+        msgs.extend(_validate_policies(task.policies, f"spec.tasks[{index}].policies"))
+        if not task.template.spec.containers:
+            msgs.append(f"task {task.name} has no containers in pod template;")
+
+    if total_replicas < job.spec.min_available:
+        msgs.append("'minAvailable' should not be greater than total replicas in tasks;")
+
+    msgs.extend(_validate_policies(job.spec.policies, "spec.policies"))
+
+    # Plugin existence (admit_job.go:169-176).
+    from volcano_tpu.controllers.job.plugins import get_plugin_builder
+
+    for name in job.spec.plugins:
+        if get_plugin_builder(name) is None:
+            msgs.append(f"unable to find job plugin: {name}")
+
+    # Duplicated volume mount paths (validateIO).
+    mount_paths = set()
+    for volume in job.spec.volumes:
+        if not volume.mount_path:
+            msgs.append("mountPath is required;")
+        elif volume.mount_path in mount_paths:
+            msgs.append(f"duplicated mountPath: {volume.mount_path};")
+        mount_paths.add(volume.mount_path)
+
+    # Queue existence (admit_job.go:179-185).
+    if api is not None:
+        if api.get("Queue", "", job.spec.queue) is None:
+            msgs.append(f"unable to find job queue: {job.spec.queue}")
+
+    if msgs:
+        raise AdmissionError(" ".join(msgs))

@@ -153,7 +153,14 @@ class SchedulerCache(Cache):
                 self.nodes[ti.node_name] = NodeInfo(None)
                 self.nodes[ti.node_name].name = ti.node_name
             if not is_terminated(ti.status):
-                self.nodes[ti.node_name].add_task(ti)
+                try:
+                    self.nodes[ti.node_name].add_task(ti)
+                except ValueError as e:
+                    # Transient double-add when our own bind's watch echo races
+                    # the in-cache accounting — the reference logs and
+                    # keeps the node-held task (event_handlers.go AddPod
+                    # error path); state converges on the next update.
+                    log.debug("add task to node: %s", e)
 
     def _delete_task(self, ti: TaskInfo) -> None:
         """event_handlers.go:126-151."""

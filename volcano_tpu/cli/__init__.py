@@ -1,0 +1,5 @@
+"""vtctl — the CLI (reference: vcctl, cmd/cli/vcctl.go + pkg/cli)."""
+
+from volcano_tpu.cli.vtctl import main
+
+__all__ = ["main"]

@@ -1,0 +1,310 @@
+"""vtctl — job and queue operations.
+
+Reference: cmd/cli/vcctl.go:43-49 + pkg/cli/{job,queue}:
+  vtctl job run|list|view|suspend|resume|delete
+  vtctl queue create|get|list|operate|delete
+
+Commands run against an APIServer instance: in-process when embedded
+(tests, single-process deployments) or a served endpoint when the control
+plane runs separately.  suspend/resume emit Command CRs consumed by the
+job controller (pkg/cli/job/suspend.go, resume.go).
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import sys
+import time
+from typing import Dict, List, Optional
+
+from volcano_tpu.apis import batch, bus, core, scheduling
+from volcano_tpu.client import APIServer, ApiError, VolcanoClient
+
+
+def _parse_resource_list(text: str) -> Dict[str, str]:
+    """"cpu=1000m,memory=100Mi" → dict (cli/util.go populateResourceListV1)."""
+    out: Dict[str, str] = {}
+    for part in (text or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"invalid resource {part!r}, expected name=quantity")
+        name, quantity = part.split("=", 1)
+        out[name.strip()] = quantity.strip()
+    return out
+
+
+def _construct_job(args) -> batch.Job:
+    """pkg/cli/job/util.go constructLaunchJobFlagsJob."""
+    requests = _parse_resource_list(args.requests)
+    limits = _parse_resource_list(args.limits)
+    task = batch.TaskSpec(
+        name=args.taskname,
+        replicas=args.replicas,
+        template=core.PodTemplateSpec(
+            metadata=core.ObjectMeta(name=args.name),
+            spec=core.PodSpec(
+                containers=[
+                    core.Container(
+                        name=args.name,
+                        image=args.image,
+                        resources={"requests": requests, "limits": limits},
+                    )
+                ]
+            ),
+        ),
+    )
+    return batch.Job(
+        metadata=core.ObjectMeta(name=args.name, namespace=args.namespace),
+        spec=batch.JobSpec(
+            min_available=args.min_available,
+            queue=args.queue,
+            scheduler_name=args.scheduler,
+            tasks=[task],
+        ),
+    )
+
+
+def _load_job_file(path: str) -> batch.Job:
+    import yaml
+
+    if not (path.endswith(".yaml") or path.endswith(".yml")):
+        raise ValueError("only support yaml file")
+    with open(path) as f:
+        data = yaml.safe_load(f)
+    return batch.Job.from_dict(data)
+
+
+def _issue_command(vc: VolcanoClient, namespace: str, job_name: str, action: str) -> None:
+    """suspend/resume create a Command CR targeted at the job."""
+    vc.create_command(
+        bus.Command(
+            metadata=core.ObjectMeta(
+                name=f"{job_name}-{action.lower()}-{int(time.time() * 1000)}",
+                namespace=namespace,
+            ),
+            action=action,
+            target_object=core.OwnerReference(kind="Job", name=job_name),
+        )
+    )
+
+
+# ---- job subcommands ----
+
+def _job_run(vc: VolcanoClient, args, out) -> int:
+    if not args.name and not args.filename:
+        print("job name cannot be left blank", file=out)
+        return 1
+    job = _load_job_file(args.filename) if args.filename else _construct_job(args)
+    if args.filename and args.namespace != "default":
+        job.metadata.namespace = args.namespace
+    created = vc.create_job(job)
+    print(f"run job {created.metadata.name} successfully", file=out)
+    return 0
+
+
+def _job_list(vc: VolcanoClient, args, out) -> int:
+    jobs = vc.list_jobs(args.namespace if args.namespace != "" else None)
+    print(
+        f"{'Name':<25}{'Creation':<21}{'Phase':<12}{'Replicas':<10}"
+        f"{'Min':<6}{'Pending':<9}{'Running':<9}{'Succeeded':<11}{'Failed':<8}",
+        file=out,
+    )
+    for job in jobs:
+        replicas = sum(t.replicas for t in job.spec.tasks)
+        created = time.strftime(
+            "%Y-%m-%d %H:%M:%S", time.localtime(job.metadata.creation_timestamp)
+        )
+        s = job.status
+        print(
+            f"{job.metadata.name:<25}{created:<21}{s.state.phase:<12}{replicas:<10}"
+            f"{s.min_available:<6}{s.pending:<9}{s.running:<9}{s.succeeded:<11}{s.failed:<8}",
+            file=out,
+        )
+    return 0
+
+
+def _job_view(vc: VolcanoClient, args, out) -> int:
+    job = vc.get_job(args.namespace, args.name)
+    if job is None:
+        print(f"job {args.namespace}/{args.name} not found", file=out)
+        return 1
+    import yaml
+
+    print(yaml.safe_dump(job.to_dict(), sort_keys=False), file=out)
+    return 0
+
+
+def _job_suspend(vc: VolcanoClient, args, out) -> int:
+    _issue_command(vc, args.namespace, args.name, batch.ABORT_JOB_ACTION)
+    print(f"suspend job {args.name} successfully", file=out)
+    return 0
+
+
+def _job_resume(vc: VolcanoClient, args, out) -> int:
+    _issue_command(vc, args.namespace, args.name, batch.RESUME_JOB_ACTION)
+    print(f"resume job {args.name} successfully", file=out)
+    return 0
+
+
+def _job_delete(vc: VolcanoClient, args, out) -> int:
+    vc.delete_job(args.namespace, args.name)
+    print(f"delete job {args.name} successfully", file=out)
+    return 0
+
+
+# ---- queue subcommands ----
+
+def _queue_create(vc: VolcanoClient, args, out) -> int:
+    vc.create_queue(
+        scheduling.Queue(
+            metadata=core.ObjectMeta(name=args.name, namespace=""),
+            spec=scheduling.QueueSpec(weight=args.weight),
+        )
+    )
+    print(f"create queue {args.name} successfully", file=out)
+    return 0
+
+
+def _queue_get(vc: VolcanoClient, args, out) -> int:
+    queue = vc.get_queue(args.name)
+    if queue is None:
+        print(f"queue {args.name} not found", file=out)
+        return 1
+    print(f"{'Name':<25}{'Weight':<8}{'State':<10}{'Inqueue':<9}{'Pending':<9}{'Running':<9}", file=out)
+    s = queue.status
+    print(
+        f"{queue.metadata.name:<25}{queue.spec.weight:<8}{s.state or queue.spec.state:<10}"
+        f"{s.inqueue:<9}{s.pending:<9}{s.running:<9}",
+        file=out,
+    )
+    return 0
+
+
+def _queue_list(vc: VolcanoClient, args, out) -> int:
+    print(f"{'Name':<25}{'Weight':<8}{'State':<10}{'Inqueue':<9}{'Pending':<9}{'Running':<9}", file=out)
+    for queue in vc.list_queues():
+        s = queue.status
+        print(
+            f"{queue.metadata.name:<25}{queue.spec.weight:<8}{s.state or queue.spec.state:<10}"
+            f"{s.inqueue:<9}{s.pending:<9}{s.running:<9}",
+            file=out,
+        )
+    return 0
+
+
+def _queue_operate(vc: VolcanoClient, args, out) -> int:
+    """pkg/cli/queue/operate.go — open/close via Command CR, update weight
+    directly."""
+    if args.action in ("open", "close"):
+        action = "OpenQueue" if args.action == "open" else "CloseQueue"
+        vc.create_command(
+            bus.Command(
+                metadata=core.ObjectMeta(
+                    name=f"{args.name}-{args.action}-{int(time.time() * 1000)}", namespace=""
+                ),
+                action=action,
+                target_object=core.OwnerReference(kind="Queue", name=args.name),
+            )
+        )
+    elif args.action == "update":
+        if args.weight is None:
+            print("update action requires --weight", file=out)
+            return 1
+        queue = vc.get_queue(args.name)
+        if queue is None:
+            print(f"queue {args.name} not found", file=out)
+            return 1
+        queue.spec.weight = args.weight
+        vc.update_queue(queue)
+    else:
+        print(f"invalid action {args.action}", file=out)
+        return 1
+    print(f"operate queue {args.name} successfully", file=out)
+    return 0
+
+
+def _queue_delete(vc: VolcanoClient, args, out) -> int:
+    vc.delete_queue(args.name)
+    print(f"delete queue {args.name} successfully", file=out)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="vtctl", description="volcano-tpu control CLI")
+    sub = parser.add_subparsers(dest="group", required=True)
+
+    job = sub.add_parser("job").add_subparsers(dest="cmd", required=True)
+
+    run = job.add_parser("run")
+    run.add_argument("--name", "-N", default="")
+    run.add_argument("--namespace", "-n", default="default")
+    run.add_argument("--image", "-i", default="busybox")
+    run.add_argument("--replicas", "-r", type=int, default=1)
+    run.add_argument("--min", dest="min_available", type=int, default=1)
+    run.add_argument("--requests", "-R", default="cpu=1000m,memory=100Mi")
+    run.add_argument("--limits", "-L", default="cpu=1000m,memory=100Mi")
+    run.add_argument("--scheduler", "-S", default="volcano-tpu")
+    run.add_argument("--queue", "-q", default="default")
+    run.add_argument("--taskname", default="task")
+    run.add_argument("--filename", "-f", default="")
+
+    for name in ("list",):
+        p = job.add_parser(name)
+        p.add_argument("--namespace", "-n", default="")
+
+    for name in ("view", "suspend", "resume", "delete"):
+        p = job.add_parser(name)
+        p.add_argument("--name", "-N", required=True)
+        p.add_argument("--namespace", "-n", default="default")
+
+    queue = sub.add_parser("queue").add_subparsers(dest="cmd", required=True)
+    qc = queue.add_parser("create")
+    qc.add_argument("--name", "-N", required=True)
+    qc.add_argument("--weight", "-w", type=int, default=1)
+    qg = queue.add_parser("get")
+    qg.add_argument("--name", "-N", required=True)
+    queue.add_parser("list")
+    qo = queue.add_parser("operate")
+    qo.add_argument("--name", "-N", required=True)
+    qo.add_argument("--action", "-a", required=True, choices=["open", "close", "update"])
+    qo.add_argument("--weight", "-w", type=int, default=None)
+    qd = queue.add_parser("delete")
+    qd.add_argument("--name", "-N", required=True)
+
+    return parser
+
+
+_HANDLERS = {
+    ("job", "run"): _job_run,
+    ("job", "list"): _job_list,
+    ("job", "view"): _job_view,
+    ("job", "suspend"): _job_suspend,
+    ("job", "resume"): _job_resume,
+    ("job", "delete"): _job_delete,
+    ("queue", "create"): _queue_create,
+    ("queue", "get"): _queue_get,
+    ("queue", "list"): _queue_list,
+    ("queue", "operate"): _queue_operate,
+    ("queue", "delete"): _queue_delete,
+}
+
+
+def main(argv: Optional[List[str]] = None, api: Optional[APIServer] = None, out=None) -> int:
+    out = out if out is not None else sys.stdout
+    args = build_parser().parse_args(argv)
+    if api is None:
+        api = APIServer()  # empty standalone instance
+    vc = VolcanoClient(api)
+    handler = _HANDLERS[(args.group, args.cmd)]
+    try:
+        return handler(vc, args, out)
+    except (ApiError, ValueError, OSError) as e:
+        print(f"error: {e}", file=out)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

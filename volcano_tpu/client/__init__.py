@@ -1,0 +1,27 @@
+from volcano_tpu.client.apiserver import (
+    ADDED,
+    MODIFIED,
+    DELETED,
+    AdmissionError,
+    AlreadyExistsError,
+    APIServer,
+    ApiError,
+    ConflictError,
+    NotFoundError,
+)
+from volcano_tpu.client.clients import KubeClient, SchedulerClient, VolcanoClient
+
+__all__ = [
+    "ADDED",
+    "MODIFIED",
+    "DELETED",
+    "AdmissionError",
+    "AlreadyExistsError",
+    "APIServer",
+    "ApiError",
+    "ConflictError",
+    "NotFoundError",
+    "KubeClient",
+    "SchedulerClient",
+    "VolcanoClient",
+]

@@ -1,0 +1,162 @@
+"""In-process API server — the communication bus of the framework.
+
+Reference architecture: Volcano's only bus is the Kubernetes API server
+(SURVEY.md §1); every binary talks exclusively to it via list/watch in and
+REST out.  This standalone framework ships its own in-process equivalent:
+a thread-safe versioned object store with watch fan-out and admission
+hooks.  Controllers, the scheduler cache, admission and the CLI all
+connect here; a real-cluster deployment swaps this module for a k8s client
+behind the same interface.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from volcano_tpu.apis import core
+
+# Watch event types (client-go semantics).
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+WatchHandler = Callable[[str, Optional[object], Optional[object]], None]
+# AdmissionHook(operation, obj) -> obj (mutating) or raises AdmissionError.
+AdmissionHook = Callable[[str, object], object]
+
+
+class ApiError(Exception):
+    pass
+
+
+class NotFoundError(ApiError):
+    pass
+
+
+class AlreadyExistsError(ApiError):
+    pass
+
+
+class ConflictError(ApiError):
+    pass
+
+
+class AdmissionError(ApiError):
+    """Request rejected by an admission hook (the webhook deny path)."""
+
+
+class APIServer:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._store: Dict[str, Dict[str, object]] = {}
+        self._watchers: Dict[str, List[WatchHandler]] = {}
+        self._admission: Dict[Tuple[str, str], List[AdmissionHook]] = {}
+        self._rv = 0
+
+    # ---- helpers ----
+
+    @staticmethod
+    def _key(obj) -> str:
+        return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+    def _bump(self, obj) -> None:
+        self._rv += 1
+        obj.metadata.resource_version = self._rv
+        if not obj.metadata.creation_timestamp:
+            obj.metadata.creation_timestamp = time.time()
+
+    def _notify(self, kind: str, event: str, old, new) -> None:
+        for handler in self._watchers.get(kind, []):
+            handler(event, old, new)
+
+    def _run_admission(self, kind: str, operation: str, obj):
+        for hook in self._admission.get((kind, operation), []):
+            obj = hook(operation, obj) or obj
+        return obj
+
+    # ---- admission registration (the webhook configuration) ----
+
+    def register_admission(self, kind: str, operation: str, hook: AdmissionHook) -> None:
+        """operation ∈ {CREATE, UPDATE}; hooks run in registration order,
+        mutating first then validating by convention."""
+        self._admission.setdefault((kind, operation), []).append(hook)
+
+    # ---- watch (the informer feed) ----
+
+    def watch(self, kind: str, handler: WatchHandler, send_initial: bool = True) -> None:
+        with self._lock:
+            self._watchers.setdefault(kind, []).append(handler)
+            if send_initial:
+                for obj in list(self._store.get(kind, {}).values()):
+                    handler(ADDED, None, obj)
+
+    # ---- CRUD ----
+
+    def create(self, obj):
+        with self._lock:
+            kind = obj.kind
+            obj = self._run_admission(kind, "CREATE", obj)
+            bucket = self._store.setdefault(kind, {})
+            key = self._key(obj)
+            if key in bucket:
+                raise AlreadyExistsError(f"{kind} {key} already exists")
+            self._bump(obj)
+            stored = obj.clone()
+            bucket[key] = stored
+            self._notify(kind, ADDED, None, stored.clone())
+            return obj
+
+    def update(self, obj):
+        with self._lock:
+            kind = obj.kind
+            obj = self._run_admission(kind, "UPDATE", obj)
+            bucket = self._store.setdefault(kind, {})
+            key = self._key(obj)
+            old = bucket.get(key)
+            if old is None:
+                raise NotFoundError(f"{kind} {key} not found")
+            self._bump(obj)
+            stored = obj.clone()
+            bucket[key] = stored
+            self._notify(kind, MODIFIED, old.clone(), stored.clone())
+            return obj
+
+    def update_status(self, obj):
+        """Status subresource write — same store, no admission."""
+        with self._lock:
+            kind = obj.kind
+            bucket = self._store.setdefault(kind, {})
+            key = self._key(obj)
+            old = bucket.get(key)
+            if old is None:
+                raise NotFoundError(f"{kind} {key} not found")
+            self._bump(obj)
+            stored = obj.clone()
+            bucket[key] = stored
+            self._notify(kind, MODIFIED, old.clone(), stored.clone())
+            return obj
+
+    def get(self, kind: str, namespace: str, name: str):
+        with self._lock:
+            obj = self._store.get(kind, {}).get(f"{namespace}/{name}")
+            return obj.clone() if obj is not None else None
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List:
+        with self._lock:
+            out = []
+            for key, obj in self._store.get(kind, {}).items():
+                if namespace is None or obj.metadata.namespace == namespace:
+                    out.append(obj.clone())
+            return sorted(out, key=lambda o: (o.metadata.namespace, o.metadata.name))
+
+    def delete(self, kind: str, namespace: str, name: str):
+        with self._lock:
+            bucket = self._store.get(kind, {})
+            key = f"{namespace}/{name}"
+            old = bucket.pop(key, None)
+            if old is None:
+                raise NotFoundError(f"{kind} {key} not found")
+            self._notify(kind, DELETED, old.clone(), None)
+            return old

@@ -1,0 +1,257 @@
+"""Typed clients over the in-process API server.
+
+Reference: the generated clientsets (pkg/client) — here thin typed
+facades, since serde/codegen is unnecessary for in-process dataclasses.
+``SchedulerClient`` is the adapter the scheduler cache drives for its
+informer feed and bind/evict/status side effects.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from volcano_tpu.apis import batch, bus, core, scheduling
+from volcano_tpu.client.apiserver import ADDED, APIServer, DELETED, MODIFIED, NotFoundError
+
+
+class KubeClient:
+    """Core-group operations (pods/nodes/services/configmaps/secrets/pvcs)."""
+
+    def __init__(self, api: APIServer):
+        self.api = api
+
+    # pods
+    def create_pod(self, pod: core.Pod) -> core.Pod:
+        return self.api.create(pod)
+
+    def get_pod(self, namespace: str, name: str) -> Optional[core.Pod]:
+        return self.api.get("Pod", namespace, name)
+
+    def list_pods(self, namespace: Optional[str] = None) -> List[core.Pod]:
+        return self.api.list("Pod", namespace)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self.api.delete("Pod", namespace, name)
+
+    def bind_pod(self, namespace: str, name: str, hostname: str) -> None:
+        """POST /binding equivalent (cache.go defaultBinder:122-134)."""
+        pod = self.api.get("Pod", namespace, name)
+        if pod is None:
+            raise NotFoundError(f"pod {namespace}/{name} not found")
+        pod.spec.node_name = hostname
+        self.api.update_status(pod)
+
+    def update_pod(self, pod: core.Pod) -> core.Pod:
+        return self.api.update(pod)
+
+    def update_pod_status(self, pod: core.Pod) -> core.Pod:
+        return self.api.update_status(pod)
+
+    def update_pod_condition(self, namespace: str, name: str, reason: str, message: str) -> None:
+        pod = self.api.get("Pod", namespace, name)
+        if pod is None:
+            return
+        for cond in pod.status.conditions:
+            if cond.type == "PodScheduled":
+                cond.status, cond.reason, cond.message = "False", reason, message
+                break
+        else:
+            pod.status.conditions.append(
+                core.PodCondition(type="PodScheduled", status="False", reason=reason, message=message)
+            )
+        self.api.update_status(pod)
+
+    # nodes
+    def create_node(self, node: core.Node) -> core.Node:
+        return self.api.create(node)
+
+    def list_nodes(self) -> List[core.Node]:
+        return self.api.list("Node")
+
+    # namespaced simple kinds
+    def create_service(self, svc: core.Service) -> core.Service:
+        return self.api.create(svc)
+
+    def get_service(self, namespace: str, name: str) -> Optional[core.Service]:
+        return self.api.get("Service", namespace, name)
+
+    def create_config_map(self, cm: core.ConfigMap) -> core.ConfigMap:
+        return self.api.create(cm)
+
+    def get_config_map(self, namespace: str, name: str) -> Optional[core.ConfigMap]:
+        return self.api.get("ConfigMap", namespace, name)
+
+    def update_config_map(self, cm: core.ConfigMap) -> core.ConfigMap:
+        return self.api.update(cm)
+
+    def create_secret(self, secret: core.Secret) -> core.Secret:
+        return self.api.create(secret)
+
+    def get_secret(self, namespace: str, name: str) -> Optional[core.Secret]:
+        return self.api.get("Secret", namespace, name)
+
+    def delete_secret(self, namespace: str, name: str) -> None:
+        self.api.delete("Secret", namespace, name)
+
+    def create_network_policy(self, np: core.NetworkPolicy) -> core.NetworkPolicy:
+        return self.api.create(np)
+
+    def create_pvc(self, pvc: core.PersistentVolumeClaim) -> core.PersistentVolumeClaim:
+        return self.api.create(pvc)
+
+    def get_pvc(self, namespace: str, name: str) -> Optional[core.PersistentVolumeClaim]:
+        return self.api.get("PersistentVolumeClaim", namespace, name)
+
+    def create_priority_class(self, pc: core.PriorityClass) -> core.PriorityClass:
+        return self.api.create(pc)
+
+    def create_event(self, event: core.Event) -> core.Event:
+        return self.api.create(event)
+
+
+class VolcanoClient:
+    """CRD-group operations (jobs/podgroups/queues/commands)."""
+
+    def __init__(self, api: APIServer):
+        self.api = api
+
+    # jobs
+    def create_job(self, job: batch.Job) -> batch.Job:
+        return self.api.create(job)
+
+    def get_job(self, namespace: str, name: str) -> Optional[batch.Job]:
+        return self.api.get("Job", namespace, name)
+
+    def list_jobs(self, namespace: Optional[str] = None) -> List[batch.Job]:
+        return self.api.list("Job", namespace)
+
+    def update_job(self, job: batch.Job) -> batch.Job:
+        return self.api.update(job)
+
+    def update_job_status(self, job: batch.Job) -> batch.Job:
+        return self.api.update_status(job)
+
+    def delete_job(self, namespace: str, name: str) -> None:
+        self.api.delete("Job", namespace, name)
+
+    # podgroups
+    def create_pod_group(self, pg: scheduling.PodGroup) -> scheduling.PodGroup:
+        return self.api.create(pg)
+
+    def get_pod_group(self, namespace: str, name: str) -> Optional[scheduling.PodGroup]:
+        return self.api.get("PodGroup", namespace, name)
+
+    def list_pod_groups(self, namespace: Optional[str] = None) -> List[scheduling.PodGroup]:
+        return self.api.list("PodGroup", namespace)
+
+    def update_pod_group(self, pg: scheduling.PodGroup) -> scheduling.PodGroup:
+        return self.api.update_status(pg)
+
+    def delete_pod_group(self, namespace: str, name: str) -> None:
+        self.api.delete("PodGroup", namespace, name)
+
+    # queues
+    def create_queue(self, queue: scheduling.Queue) -> scheduling.Queue:
+        return self.api.create(queue)
+
+    def get_queue(self, name: str) -> Optional[scheduling.Queue]:
+        return self.api.get("Queue", "", name)
+
+    def list_queues(self) -> List[scheduling.Queue]:
+        return self.api.list("Queue")
+
+    def update_queue(self, queue: scheduling.Queue) -> scheduling.Queue:
+        return self.api.update(queue)
+
+    def update_queue_status(self, queue: scheduling.Queue) -> scheduling.Queue:
+        return self.api.update_status(queue)
+
+    def delete_queue(self, name: str) -> None:
+        self.api.delete("Queue", "", name)
+
+    # commands
+    def create_command(self, cmd: bus.Command) -> bus.Command:
+        return self.api.create(cmd)
+
+    def delete_command(self, namespace: str, name: str) -> None:
+        self.api.delete("Command", namespace, name)
+
+    def list_commands(self, namespace: Optional[str] = None) -> List[bus.Command]:
+        return self.api.list("Command", namespace)
+
+
+class SchedulerClient:
+    """The scheduler cache's view: informer wiring + side-effect REST calls.
+
+    Mirrors the informer set in pkg/scheduler/cache/cache.go:321-427 (the
+    subset with behavioral content: pods, nodes, podgroups, queues,
+    priority classes, resource quotas)."""
+
+    def __init__(self, api: APIServer):
+        self.api = api
+        self.kube = KubeClient(api)
+        self.vc = VolcanoClient(api)
+
+    def watch(self, cache) -> None:
+        def pods(event, old, new):
+            if event == ADDED:
+                cache.add_pod(new)
+            elif event == MODIFIED:
+                cache.update_pod(old, new)
+            elif event == DELETED:
+                cache.delete_pod(old)
+
+        def nodes(event, old, new):
+            if event == ADDED:
+                cache.add_node(new)
+            elif event == MODIFIED:
+                cache.update_node(old, new)
+            elif event == DELETED:
+                cache.delete_node(old)
+
+        def pod_groups(event, old, new):
+            if event == ADDED:
+                cache.add_pod_group(new)
+            elif event == MODIFIED:
+                cache.update_pod_group(old, new)
+            elif event == DELETED:
+                cache.delete_pod_group(old)
+
+        def queues(event, old, new):
+            if event == ADDED:
+                cache.add_queue(new)
+            elif event == MODIFIED:
+                cache.update_queue(old, new)
+            elif event == DELETED:
+                cache.delete_queue(old)
+
+        def priority_classes(event, old, new):
+            if event in (ADDED, MODIFIED):
+                cache.add_priority_class(new)
+            elif event == DELETED:
+                cache.delete_priority_class(old)
+
+        self.api.watch("Pod", pods)
+        self.api.watch("Node", nodes)
+        self.api.watch("PodGroup", pod_groups)
+        self.api.watch("Queue", queues)
+        self.api.watch("PriorityClass", priority_classes)
+
+    # side effects used by SchedulerCache
+    def bind_pod(self, namespace: str, name: str, hostname: str) -> None:
+        self.kube.bind_pod(namespace, name, hostname)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self.kube.delete_pod(namespace, name)
+
+    def get_pod(self, namespace: str, name: str) -> Optional[core.Pod]:
+        return self.kube.get_pod(namespace, name)
+
+    def update_pod_condition(self, namespace: str, name: str, reason: str, message: str) -> None:
+        self.kube.update_pod_condition(namespace, name, reason, message)
+
+    def update_pod_group(self, pg: scheduling.PodGroup) -> Optional[scheduling.PodGroup]:
+        try:
+            return self.vc.update_pod_group(pg)
+        except NotFoundError:
+            return None

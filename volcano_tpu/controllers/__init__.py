@@ -1,0 +1,18 @@
+"""Controller manager (reference: pkg/controllers + cmd/controllers)."""
+
+from volcano_tpu.controllers.apis import JobInfo, Request
+from volcano_tpu.controllers.cache import JobCache
+from volcano_tpu.controllers.garbage_collector import GarbageCollector
+from volcano_tpu.controllers.job.job_controller import JobController
+from volcano_tpu.controllers.podgroup_controller import PodGroupController
+from volcano_tpu.controllers.queue_controller import QueueController
+
+__all__ = [
+    "JobInfo",
+    "Request",
+    "JobCache",
+    "GarbageCollector",
+    "JobController",
+    "PodGroupController",
+    "QueueController",
+]

@@ -137,13 +137,12 @@ def least_requested_score(
     ((cap-req)*10)//cap averaged over cpu+memory.
 
     Default path: float32 floor division on integer-valued lanes
-    (cpu-milli / memory-MiB).  Exact when (cap-req)*10 < 2^24 and
-    1/cap > half-ulp(10) — node capacity below ~1.6 TiB / 1600 cores,
-    where a correctly-rounded f32 quotient cannot cross an integer
-    boundary (the true quotient is ≥ 1/cap away from any unattained
-    integer).  ``int_exact`` selects exact int32 division for larger
-    nodes (matches the host plugin for any cap < 2^31/10; integer
-    division lowers slower on TPU, hence not the default).
+    (cpu-milli / memory-MiB) with a multiply-back correction, so the
+    result is exact even when XLA lowers f32 divide to reciprocal-multiply
+    (TPU): after q = floor(p/c), q is nudged so that q*c <= p < (q+1)*c
+    holds in exact f32 integer arithmetic.  Exact while the products stay
+    below 2^24 — node capacity below ~1.5 TiB / 1500 cores; ``int_exact``
+    selects exact int32 division beyond that (slower lowering on TPU).
     """
     req = task_resreq[:, None, :2] + node_used[None, :, :2]
     cap = node_alloc[None, :, :2]
@@ -156,11 +155,12 @@ def least_requested_score(
             0,
         )
         return (jnp.sum(lane, axis=-1) // 2).astype(jnp.float32)
-    lane = jnp.where(
-        (cap > 0) & (req <= cap),
-        jnp.floor((cap - req) * MAX_PRIORITY / jnp.maximum(cap, 1.0)),
-        0.0,
-    )
+    c = jnp.maximum(cap, 1.0)
+    p = (cap - req) * MAX_PRIORITY
+    q = jnp.floor(p / c)
+    # Correction for up-to-1-ulp divide error in either direction.
+    q = q + ((q + 1.0) * c <= p) - (q * c > p)
+    lane = jnp.where((cap > 0) & (req <= cap), q, 0.0)
     return jnp.floor(jnp.sum(lane, axis=-1) * 0.5)
 
 

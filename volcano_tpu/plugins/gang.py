@@ -28,9 +28,20 @@ class GangPlugin(Plugin):
 
     def on_session_open(self, ssn: Session) -> None:
         def valid_job_fn(obj) -> ValidateResult:
-            """gang.go:52-71 — enough valid tasks to reach minAvailable."""
+            """gang.go:52-71 — enough valid tasks to reach minAvailable.
+
+            PodGroupPending jobs pass: their pods may not exist yet by
+            design (delay-pod-creation: enqueue promotes Pending→Inqueue
+            from minResources alone, docs/design/delay-pod-creation.md),
+            and every pod-consuming action skips Pending PodGroups anyway
+            (allocate.go:61-63 etc.)."""
             if not isinstance(obj, JobInfo):
                 return ValidateResult(pass_=False, message=f"Failed to convert {obj} to JobInfo")
+            if (
+                obj.pod_group is not None
+                and obj.pod_group.status.phase == scheduling.POD_GROUP_PENDING
+            ):
+                return ValidateResult(pass_=True)
             vtn = obj.valid_task_num()
             if vtn < obj.min_available:
                 return ValidateResult(
