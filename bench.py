@@ -35,40 +35,60 @@ def _time(fn, warmup: int = 1, iters: int = 3) -> float:
     return float(np.median(times))
 
 
-def _relay_floor_s() -> float:
-    """Fixed device↔host round-trip latency of the harness (the dev
-    tunnel adds ~150ms per fetch; production colocates scheduler and
-    device).  Measured with a trivial jitted fetch and subtracted from the
-    session latency; both raw numbers are reported alongside."""
+def _relay_floor_s(in_bytes: int = 0, out_elems: int = 1024) -> float:
+    """Harness device-link floor: the time to push ``in_bytes`` of fresh
+    input, run a trivial kernel, and fetch ``out_elems`` int32 — i.e. the
+    cost any session of this shape pays before computing anything.  The
+    dev tunnel adds ~80-110ms of round-trip latency per session;
+    production colocates scheduler and device (PCIe, <1ms for these
+    volumes).  The headline ``value`` stays the UNADJUSTED e2e; the floor
+    and the floor-adjusted compute are reported alongside."""
     import jax
     import jax.numpy as jnp
 
     @jax.jit
-    def trivial(x):
-        return x + 1
+    def trivial(x, y):
+        return y[:out_elems].astype(jnp.int32) + jnp.int32(x.shape[0] % 2)
 
-    x = jnp.zeros(1024, jnp.int32)
-    np.asarray(trivial(x))
+    payload = np.zeros(max(in_bytes // 4, out_elems), dtype=np.float32)
+    out = np.zeros(out_elems, dtype=np.float32)
+    np.asarray(trivial(jnp.asarray(payload), jnp.asarray(out)))
     times = []
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.perf_counter()
-        np.asarray(trivial(x))
+        np.asarray(trivial(jnp.asarray(payload), jnp.asarray(out)))
         times.append(time.perf_counter() - t0)
     return float(np.median(times))
 
 
-def bench_config(name: str, kwargs: dict, iters: int = 3, relay_s: float = 0.0) -> dict:
-    from volcano_tpu.ops.kernels import run_packed
+def bench_config(name: str, kwargs: dict, iters: int = 3) -> dict:
+    from volcano_tpu.ops.dispatch import run_packed_auto as run_packed
+    from volcano_tpu.ops.dispatch import select_executor
     from volcano_tpu.ops.synthetic import generate_snapshot
     from volcano_tpu import native
 
     snap = generate_snapshot(**kwargs)
+    # Which executor the framework's auto-dispatch actually runs for this
+    # shape — 'native' means the session never touches the device (small
+    # sessions use the host C++ path), so vs_baseline is parity by design.
+    executor = select_executor(snap)
+
+    # Session input volume ≈ what run_packed_pallas actually ships per
+    # session (task rows + node planes + class feasibility).
+    in_bytes = int(
+        snap.task_resreq.nbytes
+        + snap.task_resreq.shape[0] * 8
+        + snap.node_idle.nbytes * 4
+    )
+    relay_s = _relay_floor_s(in_bytes=in_bytes, out_elems=snap.n_tasks)
 
     # Device path: end-to-end host→device→assignment latency.  The
     # headline value and vs_baseline use the UNADJUSTED e2e time; the
     # relay floor is reported alongside (compute_ms) for interpretation.
     e2e_s = _time(lambda: run_packed(snap), warmup=1, iters=iters)
-    compute_s = max(e2e_s - relay_s, 1e-9)
+    # Sessions faster than the relay floor never touched the device (host
+    # native path) — no floor to subtract.
+    compute_s = max(e2e_s - relay_s, 1e-9) if e2e_s > relay_s else e2e_s
     device_assign = run_packed(snap)
 
     # Native baseline — best of 1-thread and 16-thread (the pooled sweep
@@ -99,7 +119,11 @@ def bench_config(name: str, kwargs: dict, iters: int = 3, relay_s: float = 0.0) 
         "baseline_ms": round(baseline_s * 1e3, 3) if baseline_s == baseline_s else None,
         "compute_ms": round(compute_s * 1e3, 3),
         "relay_floor_ms": round(relay_s * 1e3, 3),
+        "vs_baseline_compute": round(baseline_s / compute_s, 2)
+        if baseline_s == baseline_s
+        else None,
         "pods_per_sec": round(placed / e2e_s),
+        "executor": executor,
         "placed": placed,
         "tasks": snap.n_tasks,
         "nodes": snap.n_nodes,
@@ -126,8 +150,7 @@ def main() -> int:
     else:
         configs = {args.config: BASELINE_CONFIGS[args.config]}
 
-    relay_s = _relay_floor_s()
-    results = [bench_config(name, kw, relay_s=relay_s) for name, kw in configs.items()]
+    results = [bench_config(name, kw) for name, kw in configs.items()]
     for r in results[:-1]:
         print(json.dumps(r), file=sys.stderr)
     print(json.dumps(results[-1]))

@@ -1,0 +1,64 @@
+"""Pallas session-kernel equivalence: the VMEM-resident full scan must
+reproduce the plain XLA scan's assignments exactly — tie-breaks, gang
+discards, taints/labels, capacity pressure — since it is the kernel the
+TPU path actually runs (ops/dispatch.py).  CPU CI uses interpret mode."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from volcano_tpu.ops.kernels import run_packed
+from volcano_tpu.ops.pallas_session import run_packed_pallas
+from volcano_tpu.ops.synthetic import generate_snapshot
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_pallas_matches_plain_random(seed):
+    snap = generate_snapshot(n_tasks=300, n_nodes=150, gang_size=4, seed=seed)
+    got = run_packed_pallas(snap, block_size=128, interpret=True)
+    assert (run_packed(snap) == got).all()
+
+
+def test_pallas_matches_plain_with_predicates():
+    snap = generate_snapshot(
+        n_tasks=256, n_nodes=130, gang_size=8, seed=3,
+        label_classes=4, taint_fraction=0.25,
+    )
+    got = run_packed_pallas(snap, block_size=128, interpret=True)
+    assert (run_packed(snap) == got).all()
+
+
+def test_pallas_matches_plain_capacity_pressure():
+    """Tight capacity: infeasible tasks, gang discards, multi-round
+    fixpoint."""
+    snap = generate_snapshot(
+        n_tasks=400, n_nodes=16, gang_size=5, seed=4,
+        node_cpu_milli=16_000, node_mem_mib=32_768,
+    )
+    plain = run_packed(snap)
+    got = run_packed_pallas(snap, block_size=128, interpret=True)
+    assert (plain == got).all()
+    assert (plain == -1).any()  # pressure actually discards gangs
+
+
+def test_pallas_matches_plain_single_node():
+    snap = generate_snapshot(n_tasks=64, n_nodes=1, gang_size=2, seed=5)
+    got = run_packed_pallas(snap, block_size=128, interpret=True)
+    assert (run_packed(snap) == got).all()
+
+
+def test_pallas_rejects_beyond_f32_envelope():
+    snap = generate_snapshot(
+        n_tasks=16, n_nodes=4, gang_size=2, seed=6,
+        node_cpu_milli=2_000_000, node_mem_mib=4_000_000,
+    )
+    with pytest.raises(ValueError):
+        run_packed_pallas(snap, block_size=128, interpret=True)
+
+
+def test_auto_dispatch_small_uses_plain():
+    from volcano_tpu.ops.dispatch import run_packed_auto
+
+    snap = generate_snapshot(n_tasks=100, n_nodes=20, gang_size=4, seed=7)
+    assert (run_packed_auto(snap) == run_packed(snap)).all()

@@ -103,7 +103,7 @@ class JaxAllocateAction(Action):
         to the host chooser.  Relational predicates the packer could not
         encode (needs_host_validation) are safe regardless: phase 3
         validates every proposal against the full host predicate set."""
-        from volcano_tpu.ops.kernels import run_packed
+        from volcano_tpu.ops.dispatch import run_packed_auto
         from volcano_tpu.ops.packing import pack_session
 
         jobs = {}
@@ -125,7 +125,9 @@ class JaxAllocateAction(Action):
         metrics.update_kernel_duration("pack", time.perf_counter() - t0)
 
         t0 = time.perf_counter()
-        assignment = run_packed(snap, weights=self.weights, gang_rounds=self.gang_rounds)
+        assignment = run_packed_auto(
+            snap, weights=self.weights, gang_rounds=self.gang_rounds
+        )
         metrics.update_kernel_duration("execute", time.perf_counter() - t0)
 
         proposals = {}

@@ -6,6 +6,7 @@ tensors (SURVEY.md §7).
 """
 
 from volcano_tpu.ops.packing import BitRegistry, PackedSnapshot, pack_session
+from volcano_tpu.ops.dispatch import run_packed_auto
 from volcano_tpu.ops.kernels import (
     DEFAULT_WEIGHTS,
     ScoreWeights,
@@ -30,5 +31,6 @@ __all__ = [
     "node_scores",
     "predicate_mask",
     "run_packed",
+    "run_packed_auto",
     "schedule_session",
 ]

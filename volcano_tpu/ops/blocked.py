@@ -40,6 +40,7 @@ from volcano_tpu.ops.kernels import (
     MAX_PRIORITY,
     ScoreWeights,
     _feasibility_classes,
+    f32_lr_exact,
     node_scores,
     step_delta_ext,
 )
@@ -309,7 +310,7 @@ def run_packed_blocked(
 ) -> np.ndarray:
     """Host wrapper with the adaptive gang fixpoint (same protocol as
     kernels.run_packed) on the blocked pass."""
-    if float(snap.node_alloc[:, :2].max(initial=0.0)) * MAX_PRIORITY >= 2**24:
+    if not f32_lr_exact(snap):
         weights = weights._replace(lr_int_exact=True)
 
     arrays, T_blk = prepare_blocked_arrays(snap, block_size)

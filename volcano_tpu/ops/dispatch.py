@@ -1,0 +1,90 @@
+"""Kernel auto-dispatch: pick the fastest exact formulation for the
+session shape and backend.
+
+Three formulations share one semantics (identical bindings, proven by
+tests/test_blocked.py and tests/test_pallas.py):
+
+  * ``run_packed_pallas`` — the whole greedy scan inside one Pallas TPU
+    kernel, node state VMEM-resident (ops/pallas_session.py).  ~50x the
+    XLA scan at 50k x 10k.  TPU only, and only within the f32
+    floor-division exactness envelope (node capacity * 10 < 2^24).
+  * ``run_packed_blocked`` — blocked top-K candidate tracking with exact
+    outside-max stop/fallback (ops/blocked.py).  Best off-TPU at scale.
+  * ``run_packed`` — the plain lax.scan (ops/kernels.py).  Smallest
+    compile, fine for small sessions and the reference for equivalence.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from volcano_tpu.ops.kernels import (
+    DEFAULT_WEIGHTS,
+    ScoreWeights,
+    f32_lr_exact,
+    run_packed,
+)
+from volcano_tpu.ops.packing import PackedSnapshot
+
+#: sessions below this task*node area keep the plain scan (compile cost
+#: of the fancier kernels outweighs the win)
+_SMALL_AREA = 1_000_000
+
+
+def _tpu_available() -> bool:
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - jax init failure
+        return False
+
+
+def select_executor(
+    snap: PackedSnapshot, weights: ScoreWeights = DEFAULT_WEIGHTS
+) -> str:
+    """Which executor run_packed_auto will use: 'native' | 'pallas' |
+    'blocked' | 'xla-scan'."""
+    area = max(snap.n_tasks, 1) * max(snap.n_nodes, 1)
+    if area < _SMALL_AREA:
+        if weights == DEFAULT_WEIGHTS:
+            from volcano_tpu import native
+
+            if native.load() is not None:
+                return "native"
+        return "xla-scan"
+    if f32_lr_exact(snap) and _tpu_available():
+        return "pallas"
+    return "blocked"
+
+
+def run_packed_auto(
+    snap: PackedSnapshot,
+    weights: ScoreWeights = DEFAULT_WEIGHTS,
+    gang_rounds: int = 3,
+) -> np.ndarray:
+    """PackedSnapshot → assignment[T], fastest exact path for the shape."""
+    area = max(snap.n_tasks, 1) * max(snap.n_nodes, 1)
+    f32_exact = f32_lr_exact(snap)
+    if area < _SMALL_AREA:
+        # Tiny sessions: the device round-trip costs more than the whole
+        # session — run the native (C++) host executor when its baked-in
+        # default weights apply (bindings-equivalent; tests/test_pallas.py,
+        # bench identical_bindings).
+        if weights == DEFAULT_WEIGHTS:
+            try:
+                from volcano_tpu import native
+
+                return native.baseline_allocate(snap, gang_rounds=gang_rounds)
+            except (RuntimeError, OSError):
+                pass  # no g++ / lib — fall through to the XLA scan
+        return run_packed(snap, weights=weights, gang_rounds=gang_rounds)
+    if f32_exact and _tpu_available():
+        from volcano_tpu.ops.pallas_session import run_packed_pallas
+
+        return run_packed_pallas(
+            snap, weights=weights, gang_rounds=gang_rounds
+        )
+    from volcano_tpu.ops.blocked import run_packed_blocked
+
+    return run_packed_blocked(snap, weights=weights, gang_rounds=gang_rounds)

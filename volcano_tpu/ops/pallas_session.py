@@ -1,0 +1,386 @@
+"""Pallas TPU kernel for the full greedy session scan.
+
+The sequential-greedy semantics (one task at a time, each placement
+feeding the next task's scores — allocate.go:177-230 +
+statement.go:199-246 in the reference) caps how much the XLA scan
+formulations can help: per step, `lax.scan` dispatches a handful of
+full-width HBM-resident ops, and the fixed per-op overhead (~µs each)
+dominates at 50k steps.  This kernel runs the ENTIRE scan inside one
+``pallas_call``:
+
+  * node state (used lanes + task count) lives in VMEM scratch across the
+    whole grid — zero HBM traffic per step;
+  * tasks stream in blocks of ``TB`` via the grid pipeline (SMEM blocks,
+    double-buffered DMA);
+  * each step is ~90 VPU ops over [NS, 128] node planes (~10 cycles per
+    op at 10k nodes) → sub-µs per task instead of tens of µs.
+
+Semantics are op-for-op identical to ops/kernels.py `schedule_pass`
+(same predicate mask, same score arithmetic and operation order, same
+first-lowest-node-index tie-break), so host/device/native bindings
+equivalence carries over.  The gang commit/discard fixpoint stays on the
+host exactly as in `run_packed` (kernels.py:432).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from volcano_tpu.ops.kernels import (
+    DEFAULT_WEIGHTS,
+    MAX_PRIORITY,
+    ScoreWeights,
+    _feasibility_classes,
+    f32_lr_exact,
+)
+from volcano_tpu.ops.packing import PackedSnapshot
+
+LANES = 128
+INT_BIG = np.int32(2**31 - 1)
+
+
+def _make_kernel(R: int, TB: int, NS: int, weights: ScoreWeights):
+    """Kernel factory — R resource lanes, TB tasks per grid step, NS node
+    sublanes (nodes = NS*128), static plugin weights."""
+
+    w_bp = float(weights.binpack_weight)
+    lane_w = [float(weights.binpack_cpu), float(weights.binpack_memory)] + [
+        float(weights.binpack_scalar)
+    ] * (R - 2)
+    w_lr = float(weights.least_requested_weight)
+    w_bal = float(weights.balanced_resource_weight)
+
+    TBS = TB // LANES
+
+    def kernel(
+        tol_ref,  # SMEM [1, R]
+        task_ref,  # VMEM [TB, R+2] — resreq lanes, feas class, active
+        cf_ref,  # VMEM [C, NS, 128] f32 class feasibility (incl. node_ok)
+        nd_ref,  # VMEM [3R+2, NS, 128] — base | alloc | used0 | count0, maxt
+        maxal_ref,  # VMEM [R, NS, 128] max(alloc, 1)
+        allocpos_ref,  # VMEM [R, NS, 128] f32 (alloc > 0)
+        chosen_ref,  # out VMEM [1, TBS, 128] i32
+        used_s,  # scratch VMEM [R, NS, 128]
+        cnt_s,  # scratch VMEM [1, NS, 128]
+        chosen_s,  # scratch VMEM [TBS, 128] i32
+    ):
+        i = pl.program_id(0)
+        base_ref = lambda r: nd_ref[r]
+        alloc_ref = lambda r: nd_ref[R + r]
+
+        @pl.when(i == 0)
+        def _():
+            used_s[:] = nd_ref[2 * R : 3 * R]
+            cnt_s[:] = nd_ref[3 * R : 3 * R + 1]
+
+        idxp = (
+            jax.lax.broadcasted_iota(jnp.int32, (NS, LANES), 0) * LANES
+            + jax.lax.broadcasted_iota(jnp.int32, (NS, LANES), 1)
+        )
+        maxt = nd_ref[3 * R + 1]
+        # scalar extraction one-hots over the task row (no SMEM scalar
+        # loads — Mosaic would relocate the whole buffer into SMEM)
+        row_lane = jax.lax.broadcasted_iota(jnp.int32, (1, R + 2), 1)
+        # chosen-plane write mask coordinates
+        csub = jax.lax.broadcasted_iota(jnp.int32, (TBS, LANES), 0)
+        clane = jax.lax.broadcasted_iota(jnp.int32, (TBS, LANES), 1)
+
+        def step(k, _):
+            row = task_ref[pl.ds(k, 1), :]  # [1, R+2]
+
+            def col(r):
+                return jnp.sum(jnp.where(row_lane == r, row, 0.0))
+
+            act = col(R + 1)
+            cls = col(R).astype(jnp.int32)
+            rr = [col(r) for r in range(R)]
+            cf = cf_ref[cls]  # [NS, 128]
+
+            # --- predicate mask (step_feasible_score semantics) ---
+            cnt = cnt_s[0]
+            fit = None
+            req = []
+            for r in range(R):
+                used_r = used_s[r]
+                idle_r = base_ref(r) - used_r
+                lane_ok = rr[r] < idle_r + tol_ref[0, r]
+                if r >= 2:
+                    lane_ok = jnp.logical_or(lane_ok, rr[r] <= tol_ref[0, r])
+                fit = lane_ok if fit is None else jnp.logical_and(fit, lane_ok)
+                req.append(rr[r] + used_r)  # shared by all three scores
+            feas = (
+                fit
+                & (cnt < maxt)
+                & (cf > 0.0)
+                & (act > 0.0)
+            )
+
+            # --- binpack (binpack_score op order) ---
+            bp = None
+            ws = jnp.float32(0.0)
+            for r in range(R):
+                if lane_w[r] == 0.0:
+                    continue
+                reqmask = rr[r] > 0.0
+                valid = (
+                    reqmask
+                    & (allocpos_ref[r] > 0.0)
+                    & (req[r] <= alloc_ref(r))
+                )
+                lane = jnp.where(valid, req[r] * lane_w[r] / maxal_ref[r], 0.0)
+                bp = lane if bp is None else bp + lane
+                ws = ws + jnp.where(reqmask, jnp.float32(lane_w[r]), 0.0)
+            if bp is None:
+                s_bp = jnp.zeros((NS, LANES), jnp.float32)
+            else:
+                s_bp = jnp.where(ws > 0.0, bp / ws, 0.0) * jnp.float32(
+                    MAX_PRIORITY * w_bp
+                )
+
+            # --- least-requested (f32 exact floor-div path) ---
+            lr = None
+            fracs = []
+            for r in range(2):
+                cap = alloc_ref(r)
+                c = maxal_ref[r]
+                p = (cap - req[r]) * jnp.float32(MAX_PRIORITY)
+                q = jnp.floor(p / c)
+                q = q + ((q + 1.0) * c <= p) - (q * c > p)
+                lane = jnp.where((allocpos_ref[r] > 0.0) & (req[r] <= cap), q, 0.0)
+                lr = lane if lr is None else lr + lane
+                # balanced fractions reuse req/cap
+                fracs.append(jnp.where(allocpos_ref[r] > 0.0, req[r] / c, 1.0))
+            s_lr = jnp.floor(lr * 0.5)
+
+            # --- balanced resource ---
+            cpu_f, mem_f = fracs
+            diff = jnp.abs(cpu_f - mem_f)
+            s_bal = jnp.floor((1.0 - diff) * jnp.float32(MAX_PRIORITY))
+            s_bal = jnp.where((cpu_f >= 1.0) | (mem_f >= 1.0), 0.0, s_bal)
+
+            total = s_bp + jnp.float32(w_lr) * s_lr + jnp.float32(w_bal) * s_bal
+            masked = jnp.where(feas, total, -jnp.inf)
+
+            # --- lowest-index argmax + state update ---
+            m = jnp.max(masked)
+            ok = jnp.isfinite(m)
+            best = jnp.min(jnp.where(masked == m, idxp, INT_BIG))
+            sel = (idxp == best) & ok
+            for r in range(R):
+                used_s[r] = used_s[r] + jnp.where(sel, rr[r], 0.0)
+            cnt_s[0] = cnt + jnp.where(sel, 1.0, 0.0)
+            kmask = (csub == k // LANES) & (clane == k % LANES)
+            chosen_s[:] = jnp.where(
+                kmask, jnp.where(ok, best, jnp.int32(-1)), chosen_s[:]
+            )
+            return 0
+
+        jax.lax.fori_loop(0, TB, step, 0)
+        chosen_ref[0] = chosen_s[:]
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("weights", "block_size", "interpret"),
+)
+def schedule_pass_pallas(
+    taskrow: jnp.ndarray,  # [T_act, R+2] f32 — resreq lanes, class, active
+    cf_u8: jnp.ndarray,  # [C, NS, 128] u8 class feasibility (incl. node_ok)
+    nd: jnp.ndarray,  # [3R+2, NS, 128] — base | alloc | used0 | count0, maxt
+    tol: jnp.ndarray,  # [1, R]
+    weights: ScoreWeights = DEFAULT_WEIGHTS,
+    block_size: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """One greedy pass on TPU → chosen[T_act] (node index or -1)."""
+    T_act, RC = taskrow.shape
+    R = RC - 2
+    C, NS, _ = cf_u8.shape
+    TB = block_size
+    assert TB % LANES == 0 and T_act % TB == 0
+    TBS = TB // LANES
+
+    # Device-side derivations (XLA, outside the kernel) — keeps the
+    # host→device transfer to taskrow + u8 feasibility + one node array.
+    cf = cf_u8.astype(jnp.float32)
+    alloc = nd[R : 2 * R]
+    maxal = jnp.maximum(alloc, 1.0)
+    allocpos = (alloc > 0.0).astype(jnp.float32)
+
+    kernel = _make_kernel(R, TB, NS, weights)
+    G = T_act // TB
+
+    full = lambda *shape: pl.BlockSpec(
+        shape, lambda i: tuple(0 for _ in shape), memory_space=pltpu.VMEM
+    )
+    chosen = pl.pallas_call(
+        kernel,
+        grid=(G,),
+        in_specs=[
+            pl.BlockSpec((1, R), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((TB, R + 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            full(C, NS, LANES),
+            full(3 * R + 2, NS, LANES),
+            full(R, NS, LANES),
+            full(R, NS, LANES),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, TBS, LANES), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((G, TBS, LANES), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((R, NS, LANES), jnp.float32),
+            pltpu.VMEM((1, NS, LANES), jnp.float32),
+            pltpu.VMEM((TBS, LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(tol, taskrow, cf, nd, maxal, allocpos)
+    return chosen.reshape(T_act)
+
+
+def _node_planes(arr: np.ndarray, NK: int) -> np.ndarray:
+    """[N_pad, R] → [R, NS, 128] f32 planes over the first NK nodes
+    (zero-padded when the snapshot's node pad is narrower than NK)."""
+    NS = NK // LANES
+    n = min(NK, arr.shape[0])
+    wide = np.zeros((NK, arr.shape[1]), dtype=np.float32)
+    wide[:n] = arr[:n]
+    return np.ascontiguousarray(wide.T).reshape(-1, NS, LANES)
+
+
+def prepare_pallas_arrays(
+    snap: PackedSnapshot, block_size: int = 256
+) -> Tuple[dict, int, int]:
+    """Host-side packing into the kernel's plane layout.
+
+    Nodes are cut to NK = ceil(n_nodes/128)*128 (instead of the pow2
+    padded width) — every per-step op is O(NK), so the tighter width is a
+    direct speedup.  Tasks are cut to T_act = ceil(n_tasks/TB)*TB.
+    """
+    TB = block_size
+    assert TB % LANES == 0, "block_size must be a multiple of 128"
+    NK = max(LANES, -(-max(snap.n_nodes, 1) // LANES) * LANES)
+    NS = NK // LANES
+    NV = min(NK, snap.node_idle.shape[0])  # valid (snapshot-backed) rows
+    T_pad = snap.task_resreq.shape[0]
+    # Always a multiple of TB (the kernel grid requires it); taskrow
+    # copying below handles T_act on either side of the snapshot's pad.
+    T_act = max(TB, -(-max(snap.n_tasks, 1) // TB) * TB)
+    R = snap.task_resreq.shape[1]
+
+    task_cls, class_sel, class_tol = _feasibility_classes(snap)
+    # class feasibility: selector bits ⊆ node labels, node taints ⊆
+    # tolerations, node_ok — identical to schedule_pass's [C, N] matrix.
+    node_labels = snap.node_label_bits[:NV]
+    node_taints = snap.node_taint_bits[:NV]
+    sel_ok = ((class_sel[:, None, :] & ~node_labels[None, :, :]) == 0).all(-1)
+    tol_ok = ((node_taints[None, :, :] & ~class_tol[:, None, :]) == 0).all(-1)
+    C = class_sel.shape[0]
+    cf = np.zeros((C, NK), dtype=np.float32)
+    cf[:, :NV] = sel_ok & tol_ok & snap.node_ok[None, :NV]
+
+    taskrow = np.zeros((T_act, R + 2), dtype=np.float32)
+    n_copy = min(T_act, T_pad)
+    taskrow[:n_copy, :R] = snap.task_resreq[:n_copy]
+    taskrow[:n_copy, R] = task_cls[:n_copy].astype(np.float32)
+    # active column filled per gang round by the caller
+
+    # One stacked node array: base | alloc | used0 | count0, maxt — a
+    # single host→device transfer (u8 feasibility likewise shrinks its
+    # transfer 4x; both matter through a high-latency device link).
+    nd = np.concatenate(
+        [
+            _node_planes(snap.node_idle + snap.node_used, NK),
+            _node_planes(snap.node_alloc, NK),
+            _node_planes(snap.node_used, NK),
+            _node_planes(
+                np.stack(
+                    [
+                        snap.node_task_count.astype(np.float32),
+                        snap.node_max_tasks.astype(np.float32),
+                    ],
+                    axis=1,
+                ),
+                NK,
+            ),
+        ]
+    )
+    arrays = dict(
+        taskrow=taskrow,
+        cf_u8=np.ascontiguousarray(
+            cf.astype(np.uint8).reshape(C, NS, LANES)
+        ),
+        nd=nd,
+        tol=snap.tolerance.reshape(1, R).astype(np.float32),
+    )
+    return arrays, T_act, NK
+
+
+def run_packed_pallas(
+    snap: PackedSnapshot,
+    weights: ScoreWeights = DEFAULT_WEIGHTS,
+    gang_rounds: int = 3,
+    block_size: int = 256,
+    interpret: bool = False,
+) -> np.ndarray:
+    """Host wrapper: PackedSnapshot → assignment[T], with the adaptive
+    gang commit/discard fixpoint host-side (same protocol as run_packed —
+    kernels.py:432)."""
+    if not f32_lr_exact(snap):
+        # Outside the f32 floor-division exactness envelope — the caller
+        # (run_packed_auto) routes such sessions to the XLA int path.
+        raise ValueError("node capacity outside f32-exact envelope")
+
+    arrays, T_act, _ = prepare_pallas_arrays(snap, block_size)
+    taskrow = arrays["taskrow"]
+    R = snap.task_resreq.shape[1]
+    dev = {
+        k: jnp.asarray(v) for k, v in arrays.items() if k != "taskrow"
+    }
+
+    active = np.zeros(T_act, dtype=bool)
+    active[: min(snap.n_tasks, T_act)] = True
+    task_job = np.zeros(T_act, dtype=np.int64)
+    n_tj = min(T_act, snap.task_job.shape[0])
+    task_job[:n_tj] = snap.task_job[:n_tj]
+    min_avail = snap.job_min_available.astype(np.int64)
+    ready_count = snap.job_ready_count.astype(np.int64)
+    n_jobs_pad = snap.job_min_available.shape[0]
+
+    chosen_np = np.full(T_act, -1, dtype=np.int32)
+    committed = np.zeros(T_act, dtype=bool)
+    for _ in range(gang_rounds):
+        taskrow[:, R + 1] = active
+        chosen = schedule_pass_pallas(
+            jnp.asarray(taskrow),
+            dev["cf_u8"],
+            dev["nd"],
+            dev["tol"],
+            weights=weights,
+            block_size=block_size,
+            interpret=interpret,
+        )
+        chosen_np = np.asarray(chosen)
+        job_assigned = np.zeros(n_jobs_pad, dtype=np.int64)
+        np.add.at(job_assigned, task_job, (chosen_np >= 0).astype(np.int64))
+        ready = job_assigned + ready_count >= min_avail
+        committed = ready[task_job] & (chosen_np >= 0)
+        next_active = active & ready[task_job]
+        if (next_active == active).all():
+            break
+        active = next_active
+
+    assignment = np.full(snap.n_tasks, -1, dtype=np.int32)
+    n = min(snap.n_tasks, T_act)
+    assignment[:n] = np.where(committed & active, chosen_np, -1)[:n]
+    return assignment
