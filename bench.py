@@ -86,9 +86,9 @@ def bench_config(name: str, kwargs: dict, iters: int = 3) -> dict:
     # headline value and vs_baseline use the UNADJUSTED e2e time; the
     # relay floor is reported alongside (compute_ms) for interpretation.
     e2e_s = _time(lambda: run_packed(snap), warmup=1, iters=iters)
-    # Sessions faster than the relay floor never touched the device (host
-    # native path) — no floor to subtract.
-    compute_s = max(e2e_s - relay_s, 1e-9) if e2e_s > relay_s else e2e_s
+    # The native host executor never touches the device — no relay floor
+    # to subtract from its sessions.
+    compute_s = e2e_s if executor == "native" else max(e2e_s - relay_s, 1e-9)
     device_assign = run_packed(snap)
 
     # Native baseline — best of 1-thread and 16-thread (the pooled sweep

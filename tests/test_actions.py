@@ -1,0 +1,318 @@
+"""Behavioral tests for the preempt / reclaim / enqueue / backfill
+actions — table cases mirroring the reference suites
+(pkg/scheduler/actions/preempt/preempt_test.go,
+reclaim/reclaim_test.go, enqueue/enqueue_test.go) on the
+fake-binder/evictor harness."""
+
+from __future__ import annotations
+
+from volcano_tpu.actions.allocate import AllocateAction
+from volcano_tpu.actions.backfill import BackfillAction
+from volcano_tpu.actions.enqueue import EnqueueAction
+from volcano_tpu.actions.preempt import PreemptAction
+from volcano_tpu.actions.reclaim import ReclaimAction
+from volcano_tpu.apis import scheduling
+from volcano_tpu.conf import Configuration
+from volcano_tpu.framework.arguments import Arguments
+
+from tests.builders import build_node, build_pod, build_pod_group, build_queue
+from tests.scheduler_helpers import make_cache, run_actions, tiers
+
+
+# ---- preempt (preempt_test.go cases) ----
+
+
+def _preempt_tiers():
+    return tiers(["conformance", "gang"])
+
+
+def test_preempt_no_eviction_when_idle_suffices():
+    """preempt_test.go 'do not preempt if there are enough idle
+    resources' — gang also vetoes same-job victims below minAvailable."""
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "10", "memory": "10G"})],
+        pods=[
+            build_pod("c1", "preemptee1", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "preemptee2", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "preemptor1", "", {"cpu": "1", "memory": "1G"},
+                      group="pg1"),
+        ],
+        pod_groups=[build_pod_group("c1", "pg1", 3, queue="q1")],
+        queues=[build_queue("q1", weight=1)],
+    )
+    run_actions(cache, [PreemptAction()], _preempt_tiers())
+    assert cache.evictor.evicts == []
+
+
+def test_preempt_no_eviction_when_jobs_pipelined():
+    """preempt_test.go 'do not preempt if job is pipelined'."""
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "3", "memory": "3G"})],
+        pods=[
+            build_pod("c1", "preemptee1", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "preemptee2", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "preemptee3", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg2"),
+            build_pod("c1", "preemptor2", "", {"cpu": "1", "memory": "1G"},
+                      group="pg2"),
+        ],
+        pod_groups=[
+            build_pod_group("c1", "pg1", 1, queue="q1"),
+            build_pod_group("c1", "pg2", 1, queue="q1"),
+        ],
+        queues=[build_queue("q1", weight=1)],
+    )
+    run_actions(cache, [PreemptAction()], _preempt_tiers())
+    assert cache.evictor.evicts == []
+
+
+def test_preempt_one_task_of_other_job():
+    """preempt_test.go 'preempt one task of different job to fit both
+    jobs on one node'."""
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "2", "memory": "2G"})],
+        pods=[
+            build_pod("c1", "preemptee1", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "preemptee2", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "preemptor1", "", {"cpu": "1", "memory": "1G"},
+                      group="pg2"),
+            build_pod("c1", "preemptor2", "", {"cpu": "1", "memory": "1G"},
+                      group="pg2"),
+        ],
+        pod_groups=[
+            build_pod_group("c1", "pg1", 1, queue="q1"),
+            build_pod_group("c1", "pg2", 1, queue="q1"),
+        ],
+        queues=[build_queue("q1", weight=1)],
+    )
+    run_actions(cache, [PreemptAction()], _preempt_tiers())
+    assert len(cache.evictor.evicts) == 1
+    assert cache.evictor.evicts[0].startswith("c1/preemptee")
+
+
+def test_preempt_enough_victims_for_large_task():
+    """preempt_test.go 'preempt enough tasks to fit large task of
+    different job' — 3 idle + 2 evictions cover the 5-cpu preemptor."""
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "6", "memory": "6G"})],
+        pods=[
+            build_pod("c1", "preemptee1", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "preemptee2", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "preemptee3", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "preemptor1", "", {"cpu": "5", "memory": "5G"},
+                      group="pg2"),
+        ],
+        pod_groups=[
+            build_pod_group("c1", "pg1", 1, queue="q1"),
+            build_pod_group("c1", "pg2", 1, queue="q1"),
+        ],
+        queues=[build_queue("q1", weight=1)],
+    )
+    run_actions(cache, [PreemptAction()], _preempt_tiers())
+    assert len(cache.evictor.evicts) == 2
+
+
+# ---- reclaim (reclaim_test.go case + guards) ----
+
+
+def _reclaim_tiers():
+    return tiers(["conformance", "gang"])
+
+
+def test_reclaim_from_overusing_queue():
+    """reclaim_test.go 'Two Queue with one Queue overusing resource,
+    should reclaim'."""
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "3", "memory": "3Gi"})],
+        pods=[
+            build_pod("c1", "preemptee1", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "preemptee2", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "preemptee3", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "preemptor1", "", {"cpu": "1", "memory": "1G"},
+                      group="pg2"),
+        ],
+        pod_groups=[
+            build_pod_group("c1", "pg1", 0, queue="q1"),
+            build_pod_group("c1", "pg2", 0, queue="q2"),
+        ],
+        queues=[build_queue("q1", weight=1), build_queue("q2", weight=1)],
+    )
+    run_actions(cache, [ReclaimAction()], _reclaim_tiers())
+    assert len(cache.evictor.evicts) == 1
+
+
+def test_reclaim_skips_same_queue_victims():
+    """No cross-queue victims → nothing reclaimed (reclaim only evicts
+    tasks whose job sits in a different queue)."""
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "3", "memory": "3Gi"})],
+        pods=[
+            build_pod("c1", "preemptee1", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "preemptee2", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "preemptee3", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "preemptor1", "", {"cpu": "1", "memory": "1G"},
+                      group="pg2"),
+        ],
+        pod_groups=[
+            build_pod_group("c1", "pg1", 0, queue="q1"),
+            build_pod_group("c1", "pg2", 0, queue="q1"),
+        ],
+        queues=[build_queue("q1", weight=1)],
+    )
+    run_actions(cache, [ReclaimAction()], _reclaim_tiers())
+    assert cache.evictor.evicts == []
+
+
+def test_reclaim_requires_enough_victim_resources():
+    """Victim total below the reclaimer's request → no eviction
+    (reclaim.go:155-163 validation)."""
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "2", "memory": "2Gi"})],
+        pods=[
+            build_pod("c1", "small", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "big", "", {"cpu": "2", "memory": "2G"},
+                      group="pg2"),
+        ],
+        pod_groups=[
+            build_pod_group("c1", "pg1", 0, queue="q1"),
+            build_pod_group("c1", "pg2", 0, queue="q2"),
+        ],
+        queues=[build_queue("q1", weight=1), build_queue("q2", weight=1)],
+    )
+    run_actions(cache, [ReclaimAction()], _reclaim_tiers())
+    assert cache.evictor.evicts == []
+
+
+# ---- enqueue ----
+
+
+def _last_pg_phase(cache):
+    """Phase the session wrote back through the status updater, falling
+    back to the cache's stored pod group when no write happened."""
+    if cache.status_updater.pod_groups:
+        return cache.status_updater.pod_groups[-1].status.phase
+    return next(iter(cache.snapshot().jobs.values())).pod_group.status.phase
+
+
+def _pending_group(ns, name, queue, min_resources):
+    return build_pod_group(
+        ns, name, 1, queue=queue,
+        phase=scheduling.POD_GROUP_PENDING,
+        min_resources=min_resources,
+    )
+
+
+def test_enqueue_flips_pending_group_within_headroom():
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "4", "memory": "8G"})],
+        pods=[build_pod("c1", "p1", "", {"cpu": "1", "memory": "1G"}, group="pg1")],
+        pod_groups=[_pending_group("c1", "pg1", "q1", {"cpu": "1", "memory": "1G"})],
+        queues=[build_queue("q1", weight=1)],
+    )
+    run_actions(cache, [EnqueueAction()], tiers(["proportion"]))
+    assert _last_pg_phase(cache) == scheduling.POD_GROUP_INQUEUE
+
+
+def test_enqueue_keeps_pending_beyond_headroom():
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "2", "memory": "2G"})],
+        pods=[build_pod("c1", "p1", "", {"cpu": "8", "memory": "8G"}, group="pg1")],
+        pod_groups=[_pending_group("c1", "pg1", "q1", {"cpu": "8", "memory": "8G"})],
+        queues=[build_queue("q1", weight=1)],
+    )
+    run_actions(cache, [EnqueueAction()], tiers(["proportion"]))
+    assert _last_pg_phase(cache) == scheduling.POD_GROUP_PENDING
+
+
+def test_enqueue_overcommit_factor_argument():
+    """enqueue_test.go: the per-action overcommit-factor configuration
+    widens the headroom gate."""
+    def mk():
+        return make_cache(
+            nodes=[build_node("n1", {"cpu": "2", "memory": "2G"})],
+            pods=[build_pod("c1", "p1", "", {"cpu": "3", "memory": "3G"}, group="pg1")],
+            pod_groups=[_pending_group("c1", "pg1", "q1", {"cpu": "3", "memory": "3G"})],
+            queues=[build_queue("q1", weight=1)],
+        )
+
+    cache = mk()
+    run_actions(cache, [EnqueueAction()], tiers(["proportion"]))
+    assert _last_pg_phase(cache) == scheduling.POD_GROUP_PENDING
+
+    wide = [Configuration(name="enqueue",
+                          arguments=Arguments({"overcommit-factor": "2.0"}))]
+    cache = mk()
+    run_actions(cache, [EnqueueAction()], tiers(["proportion"]), wide)
+    assert _last_pg_phase(cache) == scheduling.POD_GROUP_INQUEUE
+
+
+# ---- backfill ----
+
+
+def test_backfill_places_besteffort_on_full_node():
+    """Best-effort (empty resreq) tasks land even when the node has no
+    idle resources (backfill.go:61-75)."""
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "1", "memory": "1G"})],
+        pods=[
+            build_pod("c1", "filler", "n1", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1"),
+            build_pod("c1", "be1", "", {}, group="pg2"),
+        ],
+        pod_groups=[
+            build_pod_group("c1", "pg1", 0, queue="q1"),
+            build_pod_group("c1", "pg2", 0, queue="q1"),
+        ],
+        queues=[build_queue("q1", weight=1)],
+    )
+    run_actions(cache, [BackfillAction()], tiers(["gang"]))
+    assert cache.binder.binds == {"c1/be1": "n1"}
+
+
+def test_backfill_ignores_resourced_tasks():
+    """Tasks with a real request are allocate's business, not
+    backfill's."""
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "4", "memory": "4G"})],
+        pods=[build_pod("c1", "p1", "", {"cpu": "1", "memory": "1G"}, group="pg1")],
+        pod_groups=[build_pod_group("c1", "pg1", 0, queue="q1")],
+        queues=[build_queue("q1", weight=1)],
+    )
+    run_actions(cache, [BackfillAction()], tiers(["gang"]))
+    assert cache.binder.binds == {}
+
+
+def test_backfill_after_allocate_fills_leftovers():
+    """allocate then backfill: the resourced pod binds via allocate, the
+    best-effort pod via backfill."""
+    cache = make_cache(
+        nodes=[build_node("n1", {"cpu": "1", "memory": "1G"})],
+        pods=[
+            build_pod("c1", "p1", "", {"cpu": "1", "memory": "1G"}, group="pg1"),
+            build_pod("c1", "be1", "", {}, group="pg1"),
+        ],
+        pod_groups=[build_pod_group("c1", "pg1", 0, queue="q1")],
+        queues=[build_queue("q1", weight=1)],
+    )
+    run_actions(
+        cache,
+        [AllocateAction(), BackfillAction()],
+        tiers(["gang"], ["drf", "proportion"]),
+    )
+    assert cache.binder.binds == {"c1/p1": "n1", "c1/be1": "n1"}

@@ -234,3 +234,59 @@ class TestE2EJobLifecycle:
         assert pg is not None and pg.spec.min_member == 1
         stored = cluster.kube.get_pod("default", "loner")
         assert stored.spec.node_name  # scheduled as a gang of one
+
+
+PREEMPT_CONF = """
+actions: "enqueue, allocate, preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+configurations:
+- name: enqueue
+  arguments:
+    overcommit-factor: "2.0"
+"""
+
+
+class TestE2EPreemption:
+    def test_high_priority_job_preempts_low(self, tmp_path):
+        """e2e preemption: a saturated node, then a higher-priority job in
+        the same queue — preempt evicts a low-priority victim, the job
+        controller recreates it pending, and the preemptor runs."""
+        conf = tmp_path / "scheduler.yaml"
+        conf.write_text(PREEMPT_CONF)
+
+        cluster = Cluster(nodes=1, node_cpu="2", node_mem="4Gi")
+        cluster.scheduler.scheduler_conf_path = str(conf)
+        cluster.kube.create_priority_class(
+            core.PriorityClass(metadata=core.ObjectMeta(name="high"), value=1000)
+        )
+
+        submit(cluster, name="low-job", replicas=2, min_available=1)
+        cluster.tick()
+        assert cluster.vc.get_job("default", "low-job").status.running == 2
+
+        submit(
+            cluster,
+            name="high-job",
+            replicas=1,
+            min_available=1,
+            priority_class_name="high",
+        )
+        cluster.tick(rounds=6)
+
+        high = cluster.vc.get_job("default", "high-job")
+        low = cluster.vc.get_job("default", "low-job")
+        assert high.status.running == 1
+        # One victim was evicted; the controller recreated it, and it now
+        # waits pending (the node is full again).
+        assert low.status.running == 1
+        assert low.status.pending == 1

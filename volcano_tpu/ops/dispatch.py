@@ -63,28 +63,27 @@ def run_packed_auto(
     weights: ScoreWeights = DEFAULT_WEIGHTS,
     gang_rounds: int = 3,
 ) -> np.ndarray:
-    """PackedSnapshot → assignment[T], fastest exact path for the shape."""
-    area = max(snap.n_tasks, 1) * max(snap.n_nodes, 1)
-    f32_exact = f32_lr_exact(snap)
-    if area < _SMALL_AREA:
-        # Tiny sessions: the device round-trip costs more than the whole
-        # session — run the native (C++) host executor when its baked-in
-        # default weights apply (bindings-equivalent; tests/test_pallas.py,
-        # bench identical_bindings).
-        if weights == DEFAULT_WEIGHTS:
-            try:
-                from volcano_tpu import native
+    """PackedSnapshot → assignment[T], fastest exact path for the shape.
 
-                return native.baseline_allocate(snap, gang_rounds=gang_rounds)
-            except (RuntimeError, OSError):
-                pass  # no g++ / lib — fall through to the XLA scan
-        return run_packed(snap, weights=weights, gang_rounds=gang_rounds)
-    if f32_exact and _tpu_available():
+    Dispatches on :func:`select_executor` — the single copy of the
+    decision tree — so what runs always matches what callers (e.g.
+    bench.py's ``executor`` field) report."""
+    executor = select_executor(snap, weights)
+    if executor == "native":
+        from volcano_tpu import native
+
+        try:
+            return native.baseline_allocate(snap, gang_rounds=gang_rounds)
+        except RuntimeError:
+            # Native executor hit an internal error mid-session — degrade
+            # to the exact XLA scan rather than failing the session.
+            return run_packed(snap, weights=weights, gang_rounds=gang_rounds)
+    if executor == "pallas":
         from volcano_tpu.ops.pallas_session import run_packed_pallas
 
-        return run_packed_pallas(
-            snap, weights=weights, gang_rounds=gang_rounds
-        )
-    from volcano_tpu.ops.blocked import run_packed_blocked
+        return run_packed_pallas(snap, weights=weights, gang_rounds=gang_rounds)
+    if executor == "blocked":
+        from volcano_tpu.ops.blocked import run_packed_blocked
 
-    return run_packed_blocked(snap, weights=weights, gang_rounds=gang_rounds)
+        return run_packed_blocked(snap, weights=weights, gang_rounds=gang_rounds)
+    return run_packed(snap, weights=weights, gang_rounds=gang_rounds)

@@ -141,9 +141,13 @@ def _make_kernel(R: int, TB: int, NS: int, weights: ScoreWeights):
             if bp is None:
                 s_bp = jnp.zeros((NS, LANES), jnp.float32)
             else:
-                s_bp = jnp.where(ws > 0.0, bp / ws, 0.0) * jnp.float32(
-                    MAX_PRIORITY * w_bp
-                )
+                # Sequential multiplies, matching binpack_score's
+                # `score * MAX_PRIORITY * weights.binpack_weight` f32
+                # rounding exactly (folding the constants can differ by
+                # 1 ulp for non-default weights).
+                s_bp = jnp.where(ws > 0.0, bp / ws, 0.0) * jnp.float32(MAX_PRIORITY)
+                if w_bp != 1.0:
+                    s_bp = s_bp * jnp.float32(w_bp)
 
             # --- least-requested (f32 exact floor-div path) ---
             lr = None

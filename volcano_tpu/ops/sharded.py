@@ -28,6 +28,7 @@ from volcano_tpu.ops.kernels import (
     MAX_PRIORITY,
     ScoreWeights,
     _feasibility_classes,
+    f32_lr_exact,
     step_delta_ext,
     step_feasible_score,
 )
@@ -178,7 +179,7 @@ def run_packed_sharded(
     if N_pad % n_dev:
         raise ValueError(f"padded node count {N_pad} not divisible by mesh size {n_dev}")
 
-    if float(snap.node_alloc[:, :2].max(initial=0.0)) * MAX_PRIORITY >= 2**24:
+    if not f32_lr_exact(snap):
         weights = weights._replace(lr_int_exact=True)
 
     task_feas_class, class_sel, class_tol = _feasibility_classes(snap)
