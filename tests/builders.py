@@ -88,6 +88,7 @@ def build_pod_group(
     queue: str = "default",
     phase: str = scheduling.POD_GROUP_INQUEUE,
     min_resources: Optional[Dict[str, object]] = None,
+    priority_class_name: str = "",
 ) -> scheduling.PodGroup:
     return scheduling.PodGroup(
         metadata=core.ObjectMeta(
@@ -97,9 +98,18 @@ def build_pod_group(
             creation_timestamp=float(next(_ts)),
         ),
         spec=scheduling.PodGroupSpec(
-            min_member=min_member, queue=queue, min_resources=min_resources or {}
+            min_member=min_member,
+            queue=queue,
+            min_resources=min_resources or {},
+            priority_class_name=priority_class_name,
         ),
         status=scheduling.PodGroupStatus(phase=phase),
+    )
+
+
+def build_priority_class(name: str, value: int) -> core.PriorityClass:
+    return core.PriorityClass(
+        metadata=core.ObjectMeta(name=name, uid=f"pc-{next(_uid)}"), value=value
     )
 
 

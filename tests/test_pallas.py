@@ -57,8 +57,24 @@ def test_pallas_rejects_beyond_f32_envelope():
         run_packed_pallas(snap, block_size=128, interpret=True)
 
 
-def test_auto_dispatch_small_uses_plain():
-    from volcano_tpu.ops.dispatch import run_packed_auto
+def test_auto_dispatch_small_native_matches_plain():
+    """Small default-weight sessions route to the native C++ executor
+    (select_executor → 'native'); its bindings must equal the XLA scan."""
+    from volcano_tpu.ops.dispatch import run_packed_auto, select_executor
 
     snap = generate_snapshot(n_tasks=100, n_nodes=20, gang_size=4, seed=7)
+    if select_executor(snap) != "native":
+        pytest.skip("native executor unavailable (no g++)")
     assert (run_packed_auto(snap) == run_packed(snap)).all()
+
+
+def test_auto_dispatch_small_custom_weights_uses_plain():
+    """Non-default weights bypass the native executor (its weights are
+    baked in) and take the XLA scan."""
+    from volcano_tpu.ops.dispatch import run_packed_auto, select_executor
+    from volcano_tpu.ops.kernels import ScoreWeights
+
+    w = ScoreWeights(binpack_weight=2.0)
+    snap = generate_snapshot(n_tasks=100, n_nodes=20, gang_size=4, seed=7)
+    assert select_executor(snap, w) == "xla-scan"
+    assert (run_packed_auto(snap, weights=w) == run_packed(snap, weights=w)).all()

@@ -1,0 +1,267 @@
+"""Preempt dense-formulation equivalence: the packed numpy reference
+(ops/preempt_pack.py) must reproduce the host PreemptAction's evictions
+and pipelined placements exactly on identical sessions — the same
+bindings-equivalence discipline the allocate kernel has."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from volcano_tpu.actions.preempt import PreemptAction
+from volcano_tpu.conf import Tier, PluginOption
+from volcano_tpu.framework.framework import close_session, open_session
+from volcano_tpu.ops.preempt_pack import pack_preempt_session, preempt_dense
+from volcano_tpu.api import TaskStatus
+
+from tests.builders import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_priority_class,
+    build_queue,
+)
+from tests.scheduler_helpers import make_cache, tiers
+
+
+FULL_TIERS = tiers(
+    ["priority", "gang", "conformance"],
+    ["drf", "predicates", "proportion", "nodeorder", "binpack"],
+)
+
+
+def _run_host(cache):
+    """Host action → (evicted uid set, {preemptor uid: node}) read from
+    the session before close."""
+    ssn = open_session(cache, FULL_TIERS, [])
+    # pack BEFORE the action mutates session state
+    pk = pack_preempt_session(ssn)
+    PreemptAction().execute(ssn)
+    pipelined = {}
+    for job in ssn.jobs.values():
+        for t in job.task_status_index.get(TaskStatus.Pipelined, {}).values():
+            pipelined[t.uid] = t.node_name
+    close_session(ssn)
+    return set(cache.evictor.evicts), pipelined, pk
+
+
+def _dense_outcome(pk):
+    evicted, pnode = preempt_dense(pk)
+    ev_names = {pk.vic_names[i] for i in np.nonzero(evicted)[0]}
+    pipelined = {
+        pk.ptask_uids[p]: pk.node_names[pnode[p]]
+        for p in range(pk.base.n_tasks)
+        if pnode[p] >= 0
+    }
+    return ev_names, pipelined
+
+
+def _case_saturated(n_nodes=4, gangs=2, gang_size=2, seed=0):
+    """Nodes saturated with low-priority runners; pending high-priority
+    gangs that must preempt."""
+    rng = np.random.RandomState(seed)
+    nodes = [
+        build_node(f"n{i:03d}", {"cpu": "4", "memory": "8G"}) for i in range(n_nodes)
+    ]
+    pods, pgs, queues = [], [], [build_queue("q1", weight=1)]
+    # fillers: one job per node pair, priority 0, saturate cpu
+    fid = 0
+    for i in range(n_nodes):
+        for k in range(4):
+            pods.append(
+                build_pod(
+                    "ns", f"filler-{fid:03d}", f"n{i:03d}",
+                    {"cpu": "1", "memory": str(1 + int(rng.randint(0, 2))) + "G"},
+                    phase="Running", group=f"fpg{fid % 3}", priority=0,
+                )
+            )
+            fid += 1
+    for g in range(3):
+        pgs.append(build_pod_group("ns", f"fpg{g}", 1, queue="q1"))
+    # preemptors: high-priority gangs
+    for g in range(gangs):
+        pgs.append(build_pod_group("ns", f"hpg{g}", gang_size, queue="q1",
+                                   priority_class_name="high"))
+        for m in range(gang_size):
+            pods.append(
+                build_pod(
+                    "ns", f"high-{g}-{m}", "",
+                    {"cpu": "2", "memory": "2G"},
+                    group=f"hpg{g}", priority=100,
+                )
+            )
+    return make_cache(
+        nodes=nodes, pods=pods, pod_groups=pgs, queues=queues,
+        priority_classes=[build_priority_class("high", 100)],
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_dense_matches_host_saturated(seed):
+    cache = _case_saturated(seed=seed)
+    host_ev, host_pipe, pk = _run_host(cache)
+    dense_ev, dense_pipe = _dense_outcome(pk)
+    assert dense_ev == host_ev
+    assert dense_pipe == host_pipe
+    assert host_ev  # the scenario actually preempts
+
+
+def test_dense_matches_host_idle_sufficient():
+    """Enough idle resources → no evictions either way... but preempt
+    still pipelines nothing (allocate would place them)."""
+    cache = make_cache(
+        nodes=[build_node("n000", {"cpu": "10", "memory": "10G"})],
+        pods=[
+            build_pod("ns", "r1", "n000", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1", priority=0),
+            build_pod("ns", "h1", "", {"cpu": "1", "memory": "1G"},
+                      group="pg2", priority=100),
+        ],
+        pod_groups=[
+            build_pod_group("ns", "pg1", 1, queue="q1"),
+            build_pod_group("ns", "pg2", 1, queue="q1",
+                            priority_class_name="high"),
+        ],
+        queues=[build_queue("q1", weight=1)],
+        priority_classes=[build_priority_class("high", 100)],
+    )
+    host_ev, host_pipe, pk = _run_host(cache)
+    dense_ev, dense_pipe = _dense_outcome(pk)
+    assert dense_ev == host_ev
+    assert dense_pipe == host_pipe
+
+
+def test_dense_matches_host_gang_guard():
+    """Victim job at its minAvailable floor → gang vetoes, no preemption."""
+    cache = make_cache(
+        nodes=[build_node("n000", {"cpu": "2", "memory": "2G"})],
+        pods=[
+            build_pod("ns", "r1", "n000", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1", priority=0),
+            build_pod("ns", "r2", "n000", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1", priority=0),
+            build_pod("ns", "h1", "", {"cpu": "1", "memory": "1G"},
+                      group="pg2", priority=100),
+        ],
+        pod_groups=[
+            build_pod_group("ns", "pg1", 2, queue="q1"),
+            build_pod_group("ns", "pg2", 1, queue="q1",
+                            priority_class_name="high"),
+        ],
+        queues=[build_queue("q1", weight=1)],
+        priority_classes=[build_priority_class("high", 100)],
+    )
+    host_ev, host_pipe, pk = _run_host(cache)
+    dense_ev, dense_pipe = _dense_outcome(pk)
+    assert host_ev == set() and dense_ev == set()
+    assert dense_pipe == host_pipe == {}
+
+
+def test_dense_matches_host_two_queues():
+    """Preempt is in-queue only: victims in another queue are untouchable."""
+    cache = make_cache(
+        nodes=[build_node("n000", {"cpu": "2", "memory": "2G"})],
+        pods=[
+            build_pod("ns", "r1", "n000", {"cpu": "2", "memory": "2G"},
+                      phase="Running", group="pg1", priority=0),
+            build_pod("ns", "h1", "", {"cpu": "1", "memory": "1G"},
+                      group="pg2", priority=100),
+        ],
+        pod_groups=[
+            build_pod_group("ns", "pg1", 1, queue="q1"),
+            build_pod_group("ns", "pg2", 1, queue="q2",
+                            priority_class_name="high"),
+        ],
+        queues=[build_queue("q1", weight=1), build_queue("q2", weight=1)],
+        priority_classes=[build_priority_class("high", 100)],
+    )
+    host_ev, host_pipe, pk = _run_host(cache)
+    dense_ev, dense_pipe = _dense_outcome(pk)
+    assert dense_ev == host_ev == set()
+    assert dense_pipe == host_pipe == {}
+
+
+def test_dense_matches_host_mixed_priorities():
+    """Victims with mixed priorities: eviction order must pick the
+    lowest-priority ones first on the chosen node."""
+    cache = make_cache(
+        nodes=[build_node("n000", {"cpu": "3", "memory": "3G"})],
+        pods=[
+            build_pod("ns", "lo", "n000", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1", priority=0),
+            build_pod("ns", "mid", "n000", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1", priority=10),
+            build_pod("ns", "mid2", "n000", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1", priority=10),
+            build_pod("ns", "h1", "", {"cpu": "1", "memory": "1G"},
+                      group="pg2", priority=100),
+        ],
+        pod_groups=[
+            build_pod_group("ns", "pg1", 1, queue="q1"),
+            build_pod_group("ns", "pg2", 1, queue="q1",
+                            priority_class_name="high"),
+        ],
+        queues=[build_queue("q1", weight=1)],
+        priority_classes=[build_priority_class("high", 100)],
+    )
+    host_ev, host_pipe, pk = _run_host(cache)
+    dense_ev, dense_pipe = _dense_outcome(pk)
+    assert dense_ev == host_ev
+    assert dense_pipe == host_pipe
+    assert host_ev == {"ns/lo"}
+
+
+def test_dense_matches_host_equal_priority_tie():
+    """Equal-priority victims: both paths evict the youngest victim
+    first (inverse task order — the task-order fallback is creation/uid
+    ascending, so its inversion prefers the latest-created)."""
+    cache = make_cache(
+        nodes=[build_node("n000", {"cpu": "2", "memory": "2G"})],
+        pods=[
+            build_pod("ns", "va", "n000", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1", priority=0),
+            build_pod("ns", "vb", "n000", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1", priority=0),
+            build_pod("ns", "h1", "", {"cpu": "1", "memory": "1G"},
+                      group="pg2", priority=100),
+        ],
+        pod_groups=[
+            build_pod_group("ns", "pg1", 1, queue="q1"),
+            build_pod_group("ns", "pg2", 1, queue="q1",
+                            priority_class_name="high"),
+        ],
+        queues=[build_queue("q1", weight=1)],
+        priority_classes=[build_priority_class("high", 100)],
+    )
+    host_ev, host_pipe, pk = _run_host(cache)
+    dense_ev, dense_pipe = _dense_outcome(pk)
+    assert dense_ev == host_ev == {"ns/vb"}
+    assert dense_pipe == host_pipe
+
+
+def test_dense_matches_host_pod_count_limit():
+    """A node at its pod-count limit is rejected by the predicates
+    plugin in both paths, even when resources would fit."""
+    node = build_node("n000", {"cpu": "4", "memory": "4G"})
+    node.status.allocatable["pods"] = "1"
+    node.status.capacity["pods"] = "1"
+    cache = make_cache(
+        nodes=[node],
+        pods=[
+            build_pod("ns", "v1", "n000", {"cpu": "1", "memory": "1G"},
+                      phase="Running", group="pg1", priority=0),
+            build_pod("ns", "h1", "", {"cpu": "1", "memory": "1G"},
+                      group="pg2", priority=100),
+        ],
+        pod_groups=[
+            build_pod_group("ns", "pg1", 1, queue="q1"),
+            build_pod_group("ns", "pg2", 1, queue="q1",
+                            priority_class_name="high"),
+        ],
+        queues=[build_queue("q1", weight=1)],
+        priority_classes=[build_priority_class("high", 100)],
+    )
+    host_ev, host_pipe, pk = _run_host(cache)
+    dense_ev, dense_pipe = _dense_outcome(pk)
+    assert dense_ev == host_ev
+    assert dense_pipe == host_pipe == {}

@@ -143,8 +143,13 @@ def _preempt(
         if not _validate_victims(preemptor, node, victims):
             continue
 
-        # Lowest-priority victims first (preempt.go:216-221).
-        victims_queue = PriorityQueue(lambda l, r: not ssn.task_order_fn(l, r))
+        # Lowest-priority victims first (preempt.go:216-221).  The
+        # reference inverts with `!TaskOrderFn`, which makes equal-order
+        # pop sequence heap-structural (unspecified); swapping the
+        # arguments instead gives the same inverted order with a
+        # well-defined stable tie-break (insertion = uid order) — required
+        # for bindings-equivalence with the device path.
+        victims_queue = PriorityQueue(lambda l, r: ssn.task_order_fn(r, l))
         for victim in victims:
             victims_queue.push(victim)
 
