@@ -47,16 +47,76 @@ LANES = 128
 INT_BIG = np.int32(2**31 - 1)
 
 
-def _make_kernel(R: int, TB: int, NS: int, weights: ScoreWeights):
-    """Kernel factory — R resource lanes, TB tasks per grid step, NS node
-    sublanes (nodes = NS*128), static plugin weights."""
-
+def score_planes(
+    rr,  # list of R scalar resource requests
+    req,  # list of R planes: rr[r] + used[r]
+    alloc,  # callable r -> plane
+    maxal,  # callable r -> plane (max(alloc, 1))
+    allocpos,  # callable r -> plane f32 (alloc > 0)
+    weights: ScoreWeights,
+    shape,  # plane shape tuple
+):
+    """Total node-score plane for one task — the in-kernel copy of
+    kernels.py node_scores (binpack + least-requested + balanced), with
+    the same op order and f32 rounding.  Shared by the allocate scan
+    kernel below and the preempt kernel (ops/preempt_pallas.py)."""
+    R = len(rr)
     w_bp = float(weights.binpack_weight)
     lane_w = [float(weights.binpack_cpu), float(weights.binpack_memory)] + [
         float(weights.binpack_scalar)
     ] * (R - 2)
     w_lr = float(weights.least_requested_weight)
     w_bal = float(weights.balanced_resource_weight)
+
+    # --- binpack (binpack_score op order) ---
+    bp = None
+    ws = jnp.float32(0.0)
+    for r in range(R):
+        if lane_w[r] == 0.0:
+            continue
+        reqmask = rr[r] > 0.0
+        valid = reqmask & (allocpos(r) > 0.0) & (req[r] <= alloc(r))
+        lane = jnp.where(valid, req[r] * lane_w[r] / maxal(r), 0.0)
+        bp = lane if bp is None else bp + lane
+        ws = ws + jnp.where(reqmask, jnp.float32(lane_w[r]), 0.0)
+    if bp is None:
+        s_bp = jnp.zeros(shape, jnp.float32)
+    else:
+        # Sequential multiplies, matching binpack_score's
+        # `score * MAX_PRIORITY * weights.binpack_weight` f32 rounding
+        # exactly (folding the constants can differ by 1 ulp for
+        # non-default weights).
+        s_bp = jnp.where(ws > 0.0, bp / ws, 0.0) * jnp.float32(MAX_PRIORITY)
+        if w_bp != 1.0:
+            s_bp = s_bp * jnp.float32(w_bp)
+
+    # --- least-requested (f32 exact floor-div path) ---
+    lr = None
+    fracs = []
+    for r in range(2):
+        cap = alloc(r)
+        c = maxal(r)
+        p = (cap - req[r]) * jnp.float32(MAX_PRIORITY)
+        q = jnp.floor(p / c)
+        q = q + ((q + 1.0) * c <= p) - (q * c > p)
+        lane = jnp.where((allocpos(r) > 0.0) & (req[r] <= cap), q, 0.0)
+        lr = lane if lr is None else lr + lane
+        # balanced fractions reuse req/cap
+        fracs.append(jnp.where(allocpos(r) > 0.0, req[r] / c, 1.0))
+    s_lr = jnp.floor(lr * 0.5)
+
+    # --- balanced resource ---
+    cpu_f, mem_f = fracs
+    diff = jnp.abs(cpu_f - mem_f)
+    s_bal = jnp.floor((1.0 - diff) * jnp.float32(MAX_PRIORITY))
+    s_bal = jnp.where((cpu_f >= 1.0) | (mem_f >= 1.0), 0.0, s_bal)
+
+    return s_bp + jnp.float32(w_lr) * s_lr + jnp.float32(w_bal) * s_bal
+
+
+def _make_kernel(R: int, TB: int, NS: int, weights: ScoreWeights):
+    """Kernel factory — R resource lanes, TB tasks per grid step, NS node
+    sublanes (nodes = NS*128), static plugin weights."""
 
     TBS = TB // LANES
 
@@ -123,54 +183,15 @@ def _make_kernel(R: int, TB: int, NS: int, weights: ScoreWeights):
                 & (act > 0.0)
             )
 
-            # --- binpack (binpack_score op order) ---
-            bp = None
-            ws = jnp.float32(0.0)
-            for r in range(R):
-                if lane_w[r] == 0.0:
-                    continue
-                reqmask = rr[r] > 0.0
-                valid = (
-                    reqmask
-                    & (allocpos_ref[r] > 0.0)
-                    & (req[r] <= alloc_ref(r))
-                )
-                lane = jnp.where(valid, req[r] * lane_w[r] / maxal_ref[r], 0.0)
-                bp = lane if bp is None else bp + lane
-                ws = ws + jnp.where(reqmask, jnp.float32(lane_w[r]), 0.0)
-            if bp is None:
-                s_bp = jnp.zeros((NS, LANES), jnp.float32)
-            else:
-                # Sequential multiplies, matching binpack_score's
-                # `score * MAX_PRIORITY * weights.binpack_weight` f32
-                # rounding exactly (folding the constants can differ by
-                # 1 ulp for non-default weights).
-                s_bp = jnp.where(ws > 0.0, bp / ws, 0.0) * jnp.float32(MAX_PRIORITY)
-                if w_bp != 1.0:
-                    s_bp = s_bp * jnp.float32(w_bp)
-
-            # --- least-requested (f32 exact floor-div path) ---
-            lr = None
-            fracs = []
-            for r in range(2):
-                cap = alloc_ref(r)
-                c = maxal_ref[r]
-                p = (cap - req[r]) * jnp.float32(MAX_PRIORITY)
-                q = jnp.floor(p / c)
-                q = q + ((q + 1.0) * c <= p) - (q * c > p)
-                lane = jnp.where((allocpos_ref[r] > 0.0) & (req[r] <= cap), q, 0.0)
-                lr = lane if lr is None else lr + lane
-                # balanced fractions reuse req/cap
-                fracs.append(jnp.where(allocpos_ref[r] > 0.0, req[r] / c, 1.0))
-            s_lr = jnp.floor(lr * 0.5)
-
-            # --- balanced resource ---
-            cpu_f, mem_f = fracs
-            diff = jnp.abs(cpu_f - mem_f)
-            s_bal = jnp.floor((1.0 - diff) * jnp.float32(MAX_PRIORITY))
-            s_bal = jnp.where((cpu_f >= 1.0) | (mem_f >= 1.0), 0.0, s_bal)
-
-            total = s_bp + jnp.float32(w_lr) * s_lr + jnp.float32(w_bal) * s_bal
+            total = score_planes(
+                rr,
+                req,
+                alloc_ref,
+                lambda r: maxal_ref[r],
+                lambda r: allocpos_ref[r],
+                weights,
+                (NS, LANES),
+            )
             masked = jnp.where(feas, total, -jnp.inf)
 
             # --- lowest-index argmax + state update ---

@@ -316,15 +316,20 @@ def preempt_dense(
     def try_preempt(p, pjob, same_job: bool) -> bool:
         """_preempt (preempt.go:181-259) for one preemptor task."""
         resreq = base.task_resreq[p]
-        # victim eligibility at current state
+        # victim eligibility at current state.  The preemptable
+        # intersection (tier 1: priority ∩ gang ∩ conformance) applies in
+        # both phases — priority admits strictly-lower-priority JOBS, so
+        # the intra-job phase (same job ⇒ equal priority) can never evict
+        # while the priority plugin is enabled, matching the host.
+        prio_ok = pk.job_prio[pk.vic_job] < pk.job_prio[pjob]
         if same_job:
-            filt = alive & (pk.vic_job == pjob)
+            filt = alive & (pk.vic_job == pjob) & prio_ok
         else:
             filt = (
                 alive
                 & (pk.job_queue[pk.vic_job] == pk.job_queue[pjob])
                 & (pk.vic_job != pjob)
-                & (pk.job_prio[pk.vic_job] < pk.job_prio[pjob])
+                & prio_ok
             )
         # gang: victim's job must stay >= minAvailable (per-job boolean)
         gang_ok = (pk.job_min_avail[pk.vic_job] <= ready[pk.vic_job] - 1) | (

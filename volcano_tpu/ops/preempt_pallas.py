@@ -1,0 +1,355 @@
+"""Pallas TPU kernel for the preempt session pass.
+
+Runs the ENTIRE in-queue preemption replay (the dense semantics of
+ops/preempt_pack.py `preempt_dense`, itself bindings-equivalent to the
+host PreemptAction) inside one ``pallas_call``:
+
+  * victims live as node-major planes — K slots per node, each slot a
+    [NS, 128] plane, slot order within a node = the eviction order —
+    so per-attempt eligibility/sums/evictions are pure VPU plane ops,
+    no gathers or scatters;
+  * mutable state (future_idle, victim alive/gang-allowance, job
+    ready/waiting counters, per-job task cursors, outputs) lives in
+    VMEM scratch across the whole grid;
+  * the host-packed static schedule streams in through the grid
+    pipeline; each slot is one of BEGIN/ATTEMPT/END (phase 1, statement
+    scoped) or BEGIN2/ATTEMPT2 (phase 2, under-request sweep), with the
+    statement rollback implemented as shadow-buffer save/restore;
+  * node scores reuse the exact score block of the allocate kernel
+    (pallas_session.score_planes) at static ``used`` — evict/pipeline
+    never change it (see preempt_pack.py module doc).
+
+Slot kinds: 0 BEGIN1, 1 ATTEMPT1, 2 END1, 3 BEGIN2, 4 ATTEMPT2, 9 pad.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from volcano_tpu.ops.kernels import DEFAULT_WEIGHTS, ScoreWeights
+from volcano_tpu.ops.pallas_session import LANES, score_planes
+from volcano_tpu.ops.preempt_pack import PreemptPacked
+
+INT_BIG = np.int32(2**31 - 1)
+
+K_BEGIN1, K_ATT1, K_END1, K_BEGIN2, K_ATT2, K_PAD = 0, 1, 2, 3, 4, 9
+
+
+def _make_preempt_kernel(
+    R: int, K: int, NS: int, JS: int, PS: int, SB: int, C: int,
+    weights: ScoreWeights,
+):
+    shape = (NS, LANES)
+
+    def kernel(
+        tol_ref,  # SMEM [1, R]
+        sched_ref,  # VMEM [SB, 4] i32 (grid-streamed)
+        ptask_ref,  # VMEM [P_pad, R+1] f32 — resreq lanes, class
+        cf_ref,  # VMEM [C, NS, 128] f32
+        used_ref,  # VMEM [R, NS, 128] f32 (static)
+        alloc_ref,  # VMEM [R, NS, 128] f32
+        maxal_ref,  # VMEM [R, NS, 128] f32
+        allocpos_ref,  # VMEM [R, NS, 128] f32
+        fi0_ref,  # VMEM [R, NS, 128] f32
+        naux_ref,  # VMEM [2, NS, 128] f32 — ncount0, nmax
+        vr_ref,  # VMEM [R*K, NS, 128] f32 — victim resreq
+        vjob_ref,  # VMEM [K, NS, 128] i32
+        vq_ref,  # VMEM [K, NS, 128] i32 — victim job's queue
+        vjp_ref,  # VMEM [K, NS, 128] f32 — victim job priority
+        vjmin_ref,  # VMEM [K, NS, 128] f32 — victim job min_available
+        vinit_ref,  # VMEM [2*K, NS, 128] f32 — galw0 | alive0
+        jobsf_ref,  # VMEM [4, JS, 128] f32 — ready0, waiting0, minav, jprio
+        jobsi_ref,  # VMEM [1, JS, 128] i32 — cursor0
+        evicted_out,  # out VMEM [K, NS, 128] i32
+        pipelined_out,  # out VMEM [PS, 128] i32
+        fi_s,  # scratch [R, NS, 128] f32
+        ncnt_s,  # scratch [1, NS, 128] f32
+        alive_s,  # scratch [K, NS, 128] f32
+        galw_s,  # scratch [K, NS, 128] f32
+        evic_s,  # scratch [K, NS, 128] i32
+        ready_s,  # scratch [1, JS, 128] f32
+        wait_s,  # scratch [1, JS, 128] f32
+        cursor_s,  # scratch [1, JS, 128] i32
+        pipe_s,  # scratch [PS, 128] i32
+        fi_sh,  # shadow [R, NS, 128]
+        ncnt_sh,  # shadow [1, NS, 128]
+        alive_sh,  # shadow [K, NS, 128]
+        galw_sh,  # shadow [K, NS, 128]
+        evic_sh,  # shadow [K, NS, 128] i32
+        ready_sh,  # shadow [1, JS, 128]
+        wait_sh,  # shadow [1, JS, 128]
+        pipe_sh,  # shadow [PS, 128] i32
+        ph2_ref,  # SMEM scratch (1, 1) i32
+    ):
+        i = pl.program_id(0)
+        G = pl.num_programs(0)
+
+        @pl.when(i == 0)
+        def _():
+            fi_s[:] = fi0_ref[:]
+            ncnt_s[:] = naux_ref[0:1]
+            galw_s[:] = vinit_ref[0:K]
+            alive_s[:] = vinit_ref[K : 2 * K]
+            evic_s[:] = jnp.zeros((K, NS, LANES), jnp.int32)
+            ready_s[:] = jobsf_ref[0:1]
+            wait_s[:] = jobsf_ref[1:2]
+            cursor_s[:] = jobsi_ref[0:1]
+            pipe_s[:] = jnp.full((PS, LANES), -1, jnp.int32)
+            ph2_ref[0, 0] = 0
+
+        nmax = naux_ref[1]
+        idxp = (
+            jax.lax.broadcasted_iota(jnp.int32, shape, 0) * LANES
+            + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        )
+        jidx = (
+            jax.lax.broadcasted_iota(jnp.int32, (JS, LANES), 0) * LANES
+            + jax.lax.broadcasted_iota(jnp.int32, (JS, LANES), 1)
+        )
+        pidx = (
+            jax.lax.broadcasted_iota(jnp.int32, (PS, LANES), 0) * LANES
+            + jax.lax.broadcasted_iota(jnp.int32, (PS, LANES), 1)
+        )
+        row_lane = jax.lax.broadcasted_iota(jnp.int32, (1, R + 1), 1)
+        row4 = jax.lax.broadcasted_iota(jnp.int32, (1, 4), 1)
+
+        def jread(plane_ref, j):
+            jm = jidx == j
+            return jnp.sum(jnp.where(jm, plane_ref[0], 0.0))
+
+        def jread_i(plane_ref, j):
+            jm = jidx == j
+            return jnp.sum(jnp.where(jm, plane_ref[0], 0))
+
+        def pipelined_job(j):
+            return jread(wait_s, j) + jread(ready_s, j) >= jread_jobsf(2, j)
+
+        def jread_jobsf(rowi, j):
+            jm = jidx == j
+            return jnp.sum(jnp.where(jm, jobsf_ref[rowi], 0.0))
+
+        def save_shadow():
+            fi_sh[:] = fi_s[:]
+            ncnt_sh[:] = ncnt_s[:]
+            alive_sh[:] = alive_s[:]
+            galw_sh[:] = galw_s[:]
+            evic_sh[:] = evic_s[:]
+            ready_sh[:] = ready_s[:]
+            wait_sh[:] = wait_s[:]
+            pipe_sh[:] = pipe_s[:]
+
+        def restore_shadow():
+            fi_s[:] = fi_sh[:]
+            ncnt_s[:] = ncnt_sh[:]
+            alive_s[:] = alive_sh[:]
+            galw_s[:] = galw_sh[:]
+            evic_s[:] = evic_sh[:]
+            ready_s[:] = ready_sh[:]
+            wait_s[:] = wait_sh[:]
+            pipe_s[:] = pipe_sh[:]
+
+        def attempt(j, p, inter: bool):
+            """One _preempt try for preemptor task p of job j.  Returns
+            scalar bool: assigned."""
+            trow = ptask_ref[pl.ds(p, 1), :]  # [1, R+1]
+
+            def col(r):
+                return jnp.sum(jnp.where(row_lane == r, trow, 0.0))
+
+            rr = [col(r) for r in range(R)]
+            cls = col(R).astype(jnp.int32)
+            pq = jread_jobsf(3, j) * 0  # placeholder; queue read below
+            pq = jnp.sum(jnp.where(jidx == j, jobsi_ref[0] * 0, 0))  # unused
+            pprio = jread_jobsf(3, j)
+
+            # eligibility per slot k (priority ∩ gang ∩ filter)
+            elig = []
+            for k in range(K):
+                e = (alive_s[k] > 0.0) & (galw_s[k] > 0.0) & (
+                    vjp_ref[k] < pprio
+                )
+                if inter:
+                    e = e & (vq_ref[k] == jqueue_of(j)) & (vjob_ref[k] != j)
+                else:
+                    e = e & (vjob_ref[k] == j)
+                elig.append(e)
+
+            # per-node victim sums + counts
+            vsum = []
+            for r in range(R):
+                acc = None
+                for k in range(K):
+                    term = jnp.where(elig[k], vr_ref[r * K + k], 0.0)
+                    acc = term if acc is None else acc + term
+                vsum.append(acc)
+            vcnt = None
+            for k in range(K):
+                t = jnp.where(elig[k], 1.0, 0.0)
+                vcnt = t if vcnt is None else vcnt + t
+
+            # validation: victims exist + pod count + fi+victims fit
+            okl = None
+            for r in range(R):
+                lane_ok = rr[r] < fi_s[r] + vsum[r] + tol_ref[0, r]
+                if r >= 2:
+                    lane_ok = lane_ok | (rr[r] <= tol_ref[0, r])
+                okl = lane_ok if okl is None else okl & lane_ok
+            valid = (
+                (cf_ref[cls] > 0.0)
+                & (ncnt_s[0] < nmax)
+                & (vcnt > 0.0)
+                & okl
+            )
+
+            req = [rr[r] + used_ref[r] for r in range(R)]
+            total = score_planes(
+                rr,
+                req,
+                lambda r: alloc_ref[r],
+                lambda r: maxal_ref[r],
+                lambda r: allocpos_ref[r],
+                weights,
+                shape,
+            )
+            masked = jnp.where(valid, total, -jnp.inf)
+            m = jnp.max(masked)
+            okm = jnp.isfinite(m)
+            nstar = jnp.min(jnp.where(masked == m, idxp, INT_BIG))
+
+            assigned_flag = jnp.zeros((1, 1), jnp.int32)  # captured below
+
+            @pl.when(okm)
+            def _():
+                colmask = idxp == nstar
+                cum = [jnp.zeros(shape, jnp.float32) for _ in range(R)]
+                for k in range(K):
+                    notfit = None
+                    for r in range(R):
+                        lane_bad = ~(rr[r] < fi_s[r] + cum[r] + tol_ref[0, r])
+                        if r >= 2:
+                            lane_bad = lane_bad & ~(rr[r] <= tol_ref[0, r])
+                        notfit = lane_bad if notfit is None else notfit | lane_bad
+                    ev_k = elig[k] & colmask & notfit
+                    for r in range(R):
+                        cum[r] = cum[r] + jnp.where(ev_k, vr_ref[r * K + k], 0.0)
+                    alive_s[k] = jnp.where(ev_k, 0.0, alive_s[k])
+                    evic_s[k] = jnp.where(ev_k, 1, evic_s[k])
+                    # job bookkeeping for the (single) evicted victim
+                    ev_any = jnp.max(jnp.where(ev_k, 1, 0))
+
+                    @pl.when(ev_any > 0)
+                    def _():
+                        j_e = jnp.sum(jnp.where(ev_k, vjob_ref[k], 0))
+                        ready_s[0] = ready_s[0] - jnp.where(jidx == j_e, 1.0, 0.0)
+                        rj = jread(ready_s, j_e)
+                        for k2 in range(K):
+                            refreshed = jnp.where(
+                                (vjmin_ref[k2] == 1.0)
+                                | (vjmin_ref[k2] <= rj - 1.0),
+                                1.0,
+                                0.0,
+                            )
+                            galw_s[k2] = jnp.where(
+                                vjob_ref[k2] == j_e, refreshed, galw_s[k2]
+                            )
+
+                for r in range(R):
+                    fi_s[r] = fi_s[r] + cum[r]
+
+                # final fit at nstar
+                fitp = None
+                for r in range(R):
+                    lane_ok = rr[r] < fi_s[r] + tol_ref[0, r]
+                    if r >= 2:
+                        lane_ok = lane_ok | (rr[r] <= tol_ref[0, r])
+                    fitp = lane_ok if fitp is None else fitp & lane_ok
+                okfit = jnp.max(jnp.where(colmask & fitp, 1, 0)) > 0
+
+                @pl.when(okfit)
+                def _():
+                    for r in range(R):
+                        fi_s[r] = fi_s[r] - jnp.where(colmask, rr[r], 0.0)
+                    ncnt_s[0] = ncnt_s[0] + jnp.where(colmask, 1.0, 0.0)
+                    wait_s[0] = wait_s[0] + jnp.where(jidx == j, 1.0, 0.0)
+                    pipe_s[:] = jnp.where(pidx == p, nstar, pipe_s[:])
+
+                return None
+
+            # assigned = okm & okfit — recompute cheaply: a task is
+            # assigned iff its pipelined entry got written
+            got = jnp.max(jnp.where(pidx == p, pipe_s[:], -1))
+            return got >= 0
+
+        def jqueue_of(j):
+            jm = jidx == j
+            return jnp.sum(jnp.where(jm, jq_plane, 0))
+
+        jq_plane = jobsi_ref[0] * 0  # replaced below — see note
+
+        # ---- slot loop ----
+        def slot(s, _):
+            srow = sched_ref[pl.ds(s, 1), :]  # [1, 4]
+
+            def scol(c):
+                return jnp.sum(jnp.where(row4 == c, srow, 0))
+
+            kind = scol(0)
+            j = scol(1)
+            kabs = scol(2)
+
+            @pl.when(kind == K_BEGIN1)
+            def _():
+                save_shadow()
+
+            @pl.when(kind == K_ATT1)
+            def _():
+                cur = jread_i(cursor_s, j)
+                fire = (cur == kabs) & ~pipelined_job(j)
+
+                @pl.when(fire)
+                def _():
+                    cursor_s[0] = cursor_s[0] + jnp.where(jidx == j, 1, 0)
+                    attempt(j, kabs, inter=True)
+
+            @pl.when(kind == K_END1)
+            def _():
+                @pl.when(~pipelined_job(j))
+                def _():
+                    restore_shadow()
+
+            @pl.when(kind == K_BEGIN2)
+            def _():
+                ph2_ref[0, 0] = 1
+
+            @pl.when(kind == K_ATT2)
+            def _():
+                cur = jread_i(cursor_s, j)
+                fire = (cur == kabs) & (ph2_ref[0, 0] == 1)
+
+                @pl.when(fire)
+                def _():
+                    cursor_s[0] = cursor_s[0] + jnp.where(jidx == j, 1, 0)
+                    ok = attempt(j, kabs, inter=False)
+
+                    @pl.when(~ok)
+                    def _():
+                        ph2_ref[0, 0] = 0
+
+            return 0
+
+        jax.lax.fori_loop(0, SB, slot, 0)
+
+        @pl.when(i == G - 1)
+        def _():
+            evicted_out[:] = evic_s[:]
+            pipelined_out[:] = pipe_s[:]
+
+    return kernel
