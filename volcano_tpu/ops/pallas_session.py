@@ -18,8 +18,14 @@ dominates at 50k steps.  This kernel runs the ENTIRE scan inside one
 Semantics are op-for-op identical to ops/kernels.py `schedule_pass`
 (same predicate mask, same score arithmetic and operation order, same
 first-lowest-node-index tie-break), so host/device/native bindings
-equivalence carries over.  The gang commit/discard fixpoint stays on the
-host exactly as in `run_packed` (kernels.py:432).
+equivalence carries over.  The gang commit/discard fixpoint
+(Statement.Commit/Discard, statement.go:309-337) runs ON DEVICE inside
+the same jitted program (`schedule_session_pallas`): a `lax.while_loop`
+re-runs the kernel with discarded jobs deactivated until the active set
+is stable, so the whole session pays exactly ONE host→device→host round
+trip regardless of how many gang rounds it takes — through a
+high-latency device link each avoided round trip is worth far more than
+the kernel time itself.
 """
 
 from __future__ import annotations
@@ -214,34 +220,18 @@ def _make_kernel(R: int, TB: int, NS: int, weights: ScoreWeights):
     return kernel
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("weights", "block_size", "interpret"),
-)
-def schedule_pass_pallas(
-    taskrow: jnp.ndarray,  # [T_act, R+2] f32 — resreq lanes, class, active
-    cf_u8: jnp.ndarray,  # [C, NS, 128] u8 class feasibility (incl. node_ok)
-    nd: jnp.ndarray,  # [3R+2, NS, 128] — base | alloc | used0 | count0, maxt
-    tol: jnp.ndarray,  # [1, R]
-    weights: ScoreWeights = DEFAULT_WEIGHTS,
-    block_size: int = 256,
-    interpret: bool = False,
-) -> jnp.ndarray:
-    """One greedy pass on TPU → chosen[T_act] (node index or -1)."""
+def _pass_call(
+    taskrow, cf, nd, maxal, allocpos, tol, weights, block_size, interpret
+):
+    """Build + invoke the pallas_call for one greedy pass → chosen[T_act].
+    All operands already device-resident/derived; traceable inside
+    lax.while_loop (the kernel is a plain XLA custom call)."""
     T_act, RC = taskrow.shape
     R = RC - 2
-    C, NS, _ = cf_u8.shape
+    C, NS, _ = cf.shape
     TB = block_size
     assert TB % LANES == 0 and T_act % TB == 0
     TBS = TB // LANES
-
-    # Device-side derivations (XLA, outside the kernel) — keeps the
-    # host→device transfer to taskrow + u8 feasibility + one node array.
-    cf = cf_u8.astype(jnp.float32)
-    alloc = nd[R : 2 * R]
-    maxal = jnp.maximum(alloc, 1.0)
-    allocpos = (alloc > 0.0).astype(jnp.float32)
-
     kernel = _make_kernel(R, TB, NS, weights)
     G = T_act // TB
 
@@ -271,6 +261,102 @@ def schedule_pass_pallas(
         interpret=interpret,
     )(tol, taskrow, cf, nd, maxal, allocpos)
     return chosen.reshape(T_act)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("weights", "block_size", "interpret"),
+)
+def schedule_pass_pallas(
+    taskrow: jnp.ndarray,  # [T_act, R+2] f32 — resreq lanes, class, active
+    cf_u8: jnp.ndarray,  # [C, NS, 128] u8 class feasibility (incl. node_ok)
+    nd: jnp.ndarray,  # [3R+2, NS, 128] — base | alloc | used0 | count0, maxt
+    tol: jnp.ndarray,  # [1, R]
+    weights: ScoreWeights = DEFAULT_WEIGHTS,
+    block_size: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """One greedy pass on TPU → chosen[T_act] (node index or -1)."""
+    R = taskrow.shape[1] - 2
+    # Device-side derivations (XLA, outside the kernel) — keeps the
+    # host→device transfer to taskrow + u8 feasibility + one node array.
+    cf = cf_u8.astype(jnp.float32)
+    alloc = nd[R : 2 * R]
+    maxal = jnp.maximum(alloc, 1.0)
+    allocpos = (alloc > 0.0).astype(jnp.float32)
+    return _pass_call(
+        taskrow, cf, nd, maxal, allocpos, tol, weights, block_size, interpret
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("weights", "block_size", "gang_rounds", "interpret"),
+)
+def schedule_session_pallas(
+    taskrow: jnp.ndarray,  # [T_act, R+2] f32 (active column ignored)
+    cf_u8: jnp.ndarray,  # [C, NS, 128] u8
+    nd: jnp.ndarray,  # [3R+2, NS, 128]
+    tol: jnp.ndarray,  # [1, R]
+    task_job: jnp.ndarray,  # [T_act] i32 → job row
+    job_min_avail: jnp.ndarray,  # [J_pad] i32
+    job_ready: jnp.ndarray,  # [J_pad] i32
+    active0: jnp.ndarray,  # [T_act] bool
+    weights: ScoreWeights = DEFAULT_WEIGHTS,
+    block_size: int = 256,
+    gang_rounds: int = 3,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Whole session on device → assignment[T_act] (node index or -1,
+    gang-committed only).
+
+    The adaptive gang fixpoint of run_packed (kernels.py:459) runs as a
+    `lax.while_loop` around the Pallas pass: each round re-runs the scan
+    with non-ready jobs' tasks deactivated, stopping as soon as the
+    active set is stable (well-provisioned sessions: one round) or after
+    ``gang_rounds`` rounds (an unsettled fixpoint ships the last round's
+    commits — always individually valid placements).  One fused program
+    ⇒ one host→device→host round trip per session."""
+    R = taskrow.shape[1] - 2
+    J = job_min_avail.shape[0]
+    cf = cf_u8.astype(jnp.float32)
+    alloc = nd[R : 2 * R]
+    maxal = jnp.maximum(alloc, 1.0)
+    allocpos = (alloc > 0.0).astype(jnp.float32)
+    minav = job_min_avail.astype(jnp.int32)
+    readyc = job_ready.astype(jnp.int32)
+
+    def cond(carry):
+        i, _active, _chosen, _committed, done = carry
+        return jnp.logical_and(~done, i < gang_rounds)
+
+    def body(carry):
+        i, active, _chosen, _committed, _done = carry
+        tr = taskrow.at[:, R + 1].set(active.astype(jnp.float32))
+        chosen = _pass_call(
+            tr, cf, nd, maxal, allocpos, tol, weights, block_size, interpret
+        )
+        assigned = jnp.zeros((J,), jnp.int32).at[task_job].add(
+            (chosen >= 0).astype(jnp.int32)
+        )
+        ready = assigned + readyc >= minav
+        committed = ready[task_job] & (chosen >= 0)
+        next_active = active & ready[task_job]
+        done = jnp.all(next_active == active)
+        return (i + 1, next_active, chosen, committed, done)
+
+    T_act = taskrow.shape[0]
+    init = (
+        jnp.int32(0),
+        active0,
+        jnp.full((T_act,), -1, jnp.int32),
+        jnp.zeros((T_act,), bool),
+        jnp.array(False),
+    )
+    _, _, chosen, committed, _ = jax.lax.while_loop(cond, body, init)
+    # committed ⊆ {chosen >= 0} ⊆ active-at-pass, so the host's final
+    # `committed & active` mask reduces to `committed`.
+    return jnp.where(committed, chosen, -1)
 
 
 def _node_planes(arr: np.ndarray, NK: int) -> np.ndarray:
@@ -358,54 +444,38 @@ def run_packed_pallas(
     block_size: int = 256,
     interpret: bool = False,
 ) -> np.ndarray:
-    """Host wrapper: PackedSnapshot → assignment[T], with the adaptive
-    gang commit/discard fixpoint host-side (same protocol as run_packed —
-    kernels.py:432)."""
+    """Host wrapper: PackedSnapshot → assignment[T].  Packs, makes ONE
+    fused device call (gang fixpoint included — schedule_session_pallas),
+    fetches the committed assignment."""
     if not f32_lr_exact(snap):
         # Outside the f32 floor-division exactness envelope — the caller
         # (run_packed_auto) routes such sessions to the XLA int path.
         raise ValueError("node capacity outside f32-exact envelope")
 
     arrays, T_act, _ = prepare_pallas_arrays(snap, block_size)
-    taskrow = arrays["taskrow"]
-    R = snap.task_resreq.shape[1]
-    dev = {
-        k: jnp.asarray(v) for k, v in arrays.items() if k != "taskrow"
-    }
 
-    active = np.zeros(T_act, dtype=bool)
-    active[: min(snap.n_tasks, T_act)] = True
-    task_job = np.zeros(T_act, dtype=np.int64)
+    active0 = np.zeros(T_act, dtype=bool)
+    active0[: min(snap.n_tasks, T_act)] = True
+    task_job = np.zeros(T_act, dtype=np.int32)
     n_tj = min(T_act, snap.task_job.shape[0])
     task_job[:n_tj] = snap.task_job[:n_tj]
-    min_avail = snap.job_min_available.astype(np.int64)
-    ready_count = snap.job_ready_count.astype(np.int64)
-    n_jobs_pad = snap.job_min_available.shape[0]
 
-    chosen_np = np.full(T_act, -1, dtype=np.int32)
-    committed = np.zeros(T_act, dtype=bool)
-    for _ in range(gang_rounds):
-        taskrow[:, R + 1] = active
-        chosen = schedule_pass_pallas(
-            jnp.asarray(taskrow),
-            dev["cf_u8"],
-            dev["nd"],
-            dev["tol"],
-            weights=weights,
-            block_size=block_size,
-            interpret=interpret,
-        )
-        chosen_np = np.asarray(chosen)
-        job_assigned = np.zeros(n_jobs_pad, dtype=np.int64)
-        np.add.at(job_assigned, task_job, (chosen_np >= 0).astype(np.int64))
-        ready = job_assigned + ready_count >= min_avail
-        committed = ready[task_job] & (chosen_np >= 0)
-        next_active = active & ready[task_job]
-        if (next_active == active).all():
-            break
-        active = next_active
-
+    out = schedule_session_pallas(
+        jnp.asarray(arrays["taskrow"]),
+        jnp.asarray(arrays["cf_u8"]),
+        jnp.asarray(arrays["nd"]),
+        jnp.asarray(arrays["tol"]),
+        jnp.asarray(task_job),
+        jnp.asarray(snap.job_min_available.astype(np.int32)),
+        jnp.asarray(snap.job_ready_count.astype(np.int32)),
+        jnp.asarray(active0),
+        weights=weights,
+        block_size=block_size,
+        gang_rounds=gang_rounds,
+        interpret=interpret,
+    )
+    out = np.asarray(out)
     assignment = np.full(snap.n_tasks, -1, dtype=np.int32)
     n = min(snap.n_tasks, T_act)
-    assignment[:n] = np.where(committed & active, chosen_np, -1)[:n]
+    assignment[:n] = out[:n]
     return assignment
