@@ -25,14 +25,21 @@ import numpy as np
 
 def _time(fn, warmup: int = 1, iters: int = 3) -> float:
     """Median wall seconds over ``iters`` after ``warmup`` runs."""
+    return _time_r(fn, warmup=warmup, iters=iters)[0]
+
+
+def _time_r(fn, warmup: int = 1, iters: int = 3):
+    """(median wall seconds, last result) — callers that need the output
+    reuse a timed run instead of paying an extra full execution."""
+    result = None
     for _ in range(warmup):
-        fn()
+        result = fn()
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        fn()
+        result = fn()
         times.append(time.perf_counter() - t0)
-    return float(np.median(times))
+    return float(np.median(times)), result
 
 
 def _relay_floor_s(in_bytes: int = 0, out_elems: int = 1024) -> float:
@@ -131,26 +138,109 @@ def bench_config(name: str, kwargs: dict, iters: int = 3) -> dict:
     }
 
 
+def bench_preempt_config(name: str, kwargs: dict, iters: int = 3) -> dict:
+    """BASELINE config 5: the preempt pass measured end-to-end — device
+    preempt replay (ops/preempt_pallas, ≡ host PreemptAction) vs the
+    native C++ greedy preempt baseline (the reference preempt.go
+    stand-in).  ``identical_bindings`` = evicted victim sets AND
+    pipelined placements match exactly."""
+    from volcano_tpu import native
+    from volcano_tpu.ops.dispatch import select_preempt_executor
+    from volcano_tpu.ops.preempt_pack import preempt_dense
+    from volcano_tpu.ops.synthetic import generate_preempt_packed
+
+    pk = generate_preempt_packed(**kwargs)
+    executor = select_preempt_executor(pk)
+
+    in_bytes = int(
+        pk.base.task_resreq.nbytes
+        + pk.vic_resreq.nbytes
+        + pk.vic_node.nbytes * 3
+        + pk.base.node_used.nbytes * 5
+    )
+    relay_s = _relay_floor_s(in_bytes=in_bytes, out_elems=pk.base.n_tasks)
+
+    if executor == "pallas":
+        from volcano_tpu.ops.preempt_pallas import run_preempt_pallas
+
+        run = lambda: run_preempt_pallas(pk)
+    else:
+        run = lambda: preempt_dense(pk)
+    e2e_s, (dev_ev, dev_pipe) = _time_r(run, warmup=1, iters=iters)
+    compute_s = e2e_s if executor == "dense" else max(e2e_s - relay_s, 1e-9)
+
+    base_iters = 1
+    try:
+        s1, (nat_ev, nat_pipe) = _time_r(
+            lambda: native.baseline_preempt(pk, n_threads=1),
+            warmup=0, iters=base_iters,
+        )
+        s16, _ = _time_r(
+            lambda: native.baseline_preempt(pk, n_threads=16),
+            warmup=0, iters=base_iters,
+        )
+        baseline_s = min(s1, s16)
+        identical = bool(
+            np.array_equal(dev_ev, nat_ev) and np.array_equal(dev_pipe, nat_pipe)
+        )
+    except RuntimeError:
+        baseline_s = float("nan")
+        identical = False
+
+    placed = int((dev_pipe >= 0).sum())
+    return {
+        "metric": f"session_latency_{name}",
+        "value": round(e2e_s * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(baseline_s / e2e_s, 2)
+        if baseline_s == baseline_s
+        else None,
+        "baseline_ms": round(baseline_s * 1e3, 3) if baseline_s == baseline_s else None,
+        "compute_ms": round(compute_s * 1e3, 3),
+        "relay_floor_ms": round(relay_s * 1e3, 3),
+        "vs_baseline_compute": round(baseline_s / compute_s, 2)
+        if baseline_s == baseline_s
+        else None,
+        "pods_per_sec": round(placed / e2e_s),
+        "executor": executor,
+        "placed": placed,
+        "victims_evicted": int(dev_ev.sum()),
+        "tasks": pk.base.n_tasks,
+        "victims": pk.n_victims,
+        "nodes": pk.base.n_nodes,
+        "identical_bindings": identical,
+    }
+
+
 def main() -> int:
     from volcano_tpu.ops.synthetic import BASELINE_CONFIGS
 
     parser = argparse.ArgumentParser()
-    parser.add_argument("--config", default="50k_pods_10k_nodes_gang_predicates")
+    parser.add_argument("--config", default=None, help="run one named config")
     parser.add_argument("--quick", action="store_true")
-    parser.add_argument("--all", action="store_true")
+    parser.add_argument(
+        "--all", action="store_true",
+        help="(default) run every BASELINE config, headline last",
+    )
     args = parser.parse_args()
 
     headline = "50k_pods_10k_nodes_gang_predicates"
     if args.quick:
         configs = {"1k_pods_100_nodes_binpack": BASELINE_CONFIGS["1k_pods_100_nodes_binpack"]}
-    elif args.all:
-        # Headline config printed last → lands on stdout.
+    elif args.config:
+        configs = {args.config: BASELINE_CONFIGS[args.config]}
+    else:
+        # Default: ALL configs, headline printed last → lands on stdout;
+        # the others go to stderr (one JSON line each).
         configs = {k: v for k, v in BASELINE_CONFIGS.items() if k != headline}
         configs[headline] = BASELINE_CONFIGS[headline]
-    else:
-        configs = {args.config: BASELINE_CONFIGS[args.config]}
 
-    results = [bench_config(name, kw) for name, kw in configs.items()]
+    results = [
+        bench_preempt_config(name, {k: v for k, v in kw.items() if k != "preempt"})
+        if kw.get("preempt")
+        else bench_config(name, kw)
+        for name, kw in configs.items()
+    ]
     for r in results[:-1]:
         print(json.dumps(r), file=sys.stderr)
     print(json.dumps(results[-1]))

@@ -56,6 +56,22 @@ def _dense_outcome(pk):
     return ev_names, pipelined
 
 
+def _pallas_outcome(pk):
+    """Interpret-mode Pallas replay → same (evicted names, pipelined map)
+    shape as _dense_outcome, so every case below proves host ≡ dense ≡
+    pallas on identical sessions."""
+    from volcano_tpu.ops.preempt_pallas import run_preempt_pallas
+
+    evicted, pnode = run_preempt_pallas(pk, interpret=True)
+    ev_names = {pk.vic_names[i] for i in np.nonzero(evicted)[0]}
+    pipelined = {
+        pk.ptask_uids[p]: pk.node_names[pnode[p]]
+        for p in range(pk.base.n_tasks)
+        if pnode[p] >= 0
+    }
+    return ev_names, pipelined
+
+
 def _case_saturated(n_nodes=4, gangs=2, gang_size=2, seed=0):
     """Nodes saturated with low-priority runners; pending high-priority
     gangs that must preempt."""
@@ -96,13 +112,22 @@ def _case_saturated(n_nodes=4, gangs=2, gang_size=2, seed=0):
     )
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2])
-def test_dense_matches_host_saturated(seed):
-    cache = _case_saturated(seed=seed)
+def _assert_case(cache):
+    """host ≡ dense ≡ pallas on one session; returns the host outcome."""
     host_ev, host_pipe, pk = _run_host(cache)
     dense_ev, dense_pipe = _dense_outcome(pk)
     assert dense_ev == host_ev
     assert dense_pipe == host_pipe
+    pallas_ev, pallas_pipe = _pallas_outcome(pk)
+    assert pallas_ev == host_ev
+    assert pallas_pipe == host_pipe
+    return host_ev, host_pipe
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_dense_matches_host_saturated(seed):
+    cache = _case_saturated(seed=seed)
+    host_ev, host_pipe = _assert_case(cache)
     assert host_ev  # the scenario actually preempts
 
 
@@ -125,10 +150,7 @@ def test_dense_matches_host_idle_sufficient():
         queues=[build_queue("q1", weight=1)],
         priority_classes=[build_priority_class("high", 100)],
     )
-    host_ev, host_pipe, pk = _run_host(cache)
-    dense_ev, dense_pipe = _dense_outcome(pk)
-    assert dense_ev == host_ev
-    assert dense_pipe == host_pipe
+    host_ev, host_pipe = _assert_case(cache)
 
 
 def test_dense_matches_host_gang_guard():
@@ -151,10 +173,9 @@ def test_dense_matches_host_gang_guard():
         queues=[build_queue("q1", weight=1)],
         priority_classes=[build_priority_class("high", 100)],
     )
-    host_ev, host_pipe, pk = _run_host(cache)
-    dense_ev, dense_pipe = _dense_outcome(pk)
-    assert host_ev == set() and dense_ev == set()
-    assert dense_pipe == host_pipe == {}
+    host_ev, host_pipe = _assert_case(cache)
+    assert host_ev == set()
+    assert host_pipe == {}
 
 
 def test_dense_matches_host_two_queues():
@@ -175,10 +196,9 @@ def test_dense_matches_host_two_queues():
         queues=[build_queue("q1", weight=1), build_queue("q2", weight=1)],
         priority_classes=[build_priority_class("high", 100)],
     )
-    host_ev, host_pipe, pk = _run_host(cache)
-    dense_ev, dense_pipe = _dense_outcome(pk)
-    assert dense_ev == host_ev == set()
-    assert dense_pipe == host_pipe == {}
+    host_ev, host_pipe = _assert_case(cache)
+    assert host_ev == set()
+    assert host_pipe == {}
 
 
 def test_dense_matches_host_mixed_priorities():
@@ -204,10 +224,7 @@ def test_dense_matches_host_mixed_priorities():
         queues=[build_queue("q1", weight=1)],
         priority_classes=[build_priority_class("high", 100)],
     )
-    host_ev, host_pipe, pk = _run_host(cache)
-    dense_ev, dense_pipe = _dense_outcome(pk)
-    assert dense_ev == host_ev
-    assert dense_pipe == host_pipe
+    host_ev, host_pipe = _assert_case(cache)
     assert host_ev == {"ns/lo"}
 
 
@@ -233,10 +250,8 @@ def test_dense_matches_host_equal_priority_tie():
         queues=[build_queue("q1", weight=1)],
         priority_classes=[build_priority_class("high", 100)],
     )
-    host_ev, host_pipe, pk = _run_host(cache)
-    dense_ev, dense_pipe = _dense_outcome(pk)
-    assert dense_ev == host_ev == {"ns/vb"}
-    assert dense_pipe == host_pipe
+    host_ev, host_pipe = _assert_case(cache)
+    assert host_ev == {"ns/vb"}
 
 
 def test_dense_matches_host_pod_count_limit():
@@ -261,7 +276,66 @@ def test_dense_matches_host_pod_count_limit():
         queues=[build_queue("q1", weight=1)],
         priority_classes=[build_priority_class("high", 100)],
     )
-    host_ev, host_pipe, pk = _run_host(cache)
-    dense_ev, dense_pipe = _dense_outcome(pk)
-    assert dense_ev == host_ev
-    assert dense_pipe == host_pipe == {}
+    host_ev, host_pipe = _assert_case(cache)
+    assert host_pipe == {}
+
+
+# ---- JaxPreemptAction: device-dispatched action ≡ host action ----
+
+
+def _run_action(cache, action):
+    """Run an action on a fresh session → (evicted set, pipelined map).
+    Pipelined keys are ns/name (uids are a global counter, so they
+    differ between two identically-built caches)."""
+    ssn = open_session(cache, FULL_TIERS, [])
+    action.execute(ssn)
+    pipelined = {}
+    for job in ssn.jobs.values():
+        for t in job.task_status_index.get(TaskStatus.Pipelined, {}).values():
+            pipelined[f"{t.namespace}/{t.name}"] = t.node_name
+    close_session(ssn)
+    return set(cache.evictor.evicts), pipelined
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_jax_preempt_action_matches_host(seed):
+    """JaxPreemptAction on one cache ≡ PreemptAction on an identical
+    cache: same evictions, same pipelined placements."""
+    from volcano_tpu.actions.jax_preempt import JaxPreemptAction
+
+    host_ev, host_pipe = _run_action(_case_saturated(seed=seed), PreemptAction())
+    dev_ev, dev_pipe = _run_action(_case_saturated(seed=seed), JaxPreemptAction())
+    assert dev_ev == host_ev
+    assert dev_pipe == host_pipe
+    assert host_ev  # scenario actually preempts
+
+
+def test_jax_preempt_action_noop_when_nothing_starves():
+    from volcano_tpu.actions.jax_preempt import JaxPreemptAction
+
+    cache = make_cache(
+        nodes=[build_node("n000", {"cpu": "4", "memory": "4G"})],
+        pods=[build_pod("ns", "r1", "n000", {"cpu": "1", "memory": "1G"},
+                        phase="Running", group="pg1", priority=0)],
+        pod_groups=[build_pod_group("ns", "pg1", 1, queue="q1")],
+        queues=[build_queue("q1", weight=1)],
+    )
+    ev, pipe = _run_action(cache, JaxPreemptAction())
+    assert ev == set() and pipe == {}
+
+
+def test_jax_preempt_action_tier_fallback():
+    """A session whose preemptable tier differs from the supported
+    intersection routes to the host action (pack refuses loudly)."""
+    from volcano_tpu.actions.jax_preempt import JaxPreemptAction
+
+    bad_tiers = tiers(["priority", "gang", "conformance", "drf"], [])
+    cache = _case_saturated(seed=0)
+    ssn = open_session(cache, bad_tiers, [])
+    JaxPreemptAction().execute(ssn)  # must not raise
+    close_session(ssn)
+    host_cache = _case_saturated(seed=0)
+    hssn = open_session(host_cache, bad_tiers, [])
+    PreemptAction().execute(hssn)
+    close_session(hssn)
+    assert set(cache.evictor.evicts) == set(host_cache.evictor.evicts)

@@ -3,7 +3,15 @@ cmd/scheduler/main.go:36-38."""
 
 from volcano_tpu.framework.interface import register_action
 
-from volcano_tpu.actions import allocate, backfill, enqueue, jax_allocate, preempt, reclaim
+from volcano_tpu.actions import (
+    allocate,
+    backfill,
+    enqueue,
+    jax_allocate,
+    jax_preempt,
+    preempt,
+    reclaim,
+)
 
 
 def register_all() -> None:
@@ -13,6 +21,7 @@ def register_all() -> None:
     register_action(preempt.new())
     register_action(reclaim.new())
     register_action(jax_allocate.new())
+    register_action(jax_preempt.new())
 
 
 register_all()

@@ -63,6 +63,20 @@ def load() -> Optional[ctypes.CDLL]:
         i32,                             # out assignment
     ]
     lib.baseline_allocate.restype = ctypes.c_int
+    i64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.baseline_preempt.argtypes = [
+        f32, u32, u32,                   # preemptor task arrays
+        f32, f32, f32, u32, u32, u8,     # node arrays (used/alloc/fi0/bits/ok)
+        i32, i32,                        # node count/max
+        f32, i32, i32,                   # victim arrays
+        i64, i32, i32, i32, i32, i32, i32,  # job tables
+        i32,                             # schedule [S,2]
+        f32,                             # tolerance
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        u8, i32,                         # out evicted / pipelined
+    ]
+    lib.baseline_preempt.restype = ctypes.c_int
     _lib = lib
     return lib
 
@@ -111,3 +125,54 @@ def baseline_allocate(snap, n_threads: int = 16, gang_rounds: int = 3) -> np.nda
     if rc != 0:
         raise RuntimeError(f"baseline_allocate failed: {rc}")
     return out[:task_valid_rows]
+
+
+def baseline_preempt(pk, n_threads: int = 16):
+    """Run the native greedy preempt on a PreemptPacked →
+    (evicted[V] bool, pipelined_node[P] i32).  Semantics mirror
+    ops/preempt_pack.preempt_dense (the host PreemptAction replay)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native baseline unavailable (g++ missing?)")
+    base = pk.base
+    P = base.n_tasks
+    N = base.n_nodes
+    V = pk.n_victims
+    J = pk.n_jobs
+    R = base.task_resreq.shape[1]
+    W = base.task_sel_bits.shape[1]
+    S = pk.schedule.shape[0]
+    evicted = np.zeros(max(V, 1), dtype=np.uint8)
+    pipelined = np.full(max(P, 1), -1, dtype=np.int32)
+    if P == 0 or S == 0:
+        return evicted[:V].astype(bool), pipelined[:P]
+    rc = lib.baseline_preempt(
+        np.ascontiguousarray(base.task_resreq[:P]),
+        np.ascontiguousarray(base.task_sel_bits[:P]),
+        np.ascontiguousarray(base.task_tol_bits[:P]),
+        np.ascontiguousarray(base.node_used[:N]),
+        np.ascontiguousarray(base.node_alloc[:N]),
+        np.ascontiguousarray(pk.node_fi0[:N]),
+        np.ascontiguousarray(base.node_label_bits[:N]),
+        np.ascontiguousarray(base.node_taint_bits[:N]),
+        np.ascontiguousarray(base.node_ok[:N].astype(np.uint8)),
+        np.ascontiguousarray(base.node_task_count[:N]),
+        np.ascontiguousarray(base.node_max_tasks[:N]),
+        np.ascontiguousarray(pk.vic_resreq[: max(V, 1)]),
+        np.ascontiguousarray(pk.vic_node[: max(V, 1)]),
+        np.ascontiguousarray(pk.vic_job[: max(V, 1)]),
+        np.ascontiguousarray(pk.job_prio.astype(np.int64)),
+        np.ascontiguousarray(pk.job_min_avail),
+        np.ascontiguousarray(pk.job_ready0),
+        np.ascontiguousarray(pk.job_waiting0),
+        np.ascontiguousarray(pk.job_queue),
+        np.ascontiguousarray(pk.job_ptask_start),
+        np.ascontiguousarray(pk.job_ptask_end),
+        np.ascontiguousarray(pk.schedule),
+        np.ascontiguousarray(base.tolerance),
+        P, N, V, J, R, W, S, n_threads,
+        evicted, pipelined,
+    )
+    if rc != 0:
+        raise RuntimeError(f"baseline_preempt failed: {rc}")
+    return evicted[:V].astype(bool), pipelined[:P]

@@ -30,6 +30,10 @@ from volcano_tpu.ops.packing import PackedSnapshot
 #: of the fancier kernels outweighs the win)
 _SMALL_AREA = 1_000_000
 
+#: VMEM budget for Pallas kernels.  v5e VMEM is 128 MiB; leave headroom
+#: for Mosaic's own buffers and the double-buffered grid pipeline.
+_VMEM_BUDGET = 96 * 1024 * 1024
+
 
 def _tpu_available() -> bool:
     try:
@@ -54,8 +58,28 @@ def select_executor(
                 return "native"
         return "xla-scan"
     if f32_lr_exact(snap) and _tpu_available():
-        return "pallas"
+        from volcano_tpu.ops.pallas_session import pallas_vmem_bytes
+
+        if pallas_vmem_bytes(snap) <= _VMEM_BUDGET:
+            return "pallas"
     return "blocked"
+
+
+def select_preempt_executor(pk) -> str:
+    """Executor for the preempt pass: 'pallas' | 'dense'.  Same decision
+    shape as select_executor — pallas only on TPU, inside the f32
+    envelope, and within the VMEM budget (the preempt kernel's footprint
+    additionally scales with K = max victims per node)."""
+    base = pk.base
+    area = max(base.n_tasks, 1) * max(base.n_nodes, 1)
+    if area < _SMALL_AREA:
+        return "dense"
+    if f32_lr_exact(base) and _tpu_available():
+        from volcano_tpu.ops.preempt_pallas import preempt_vmem_bytes
+
+        if preempt_vmem_bytes(pk) <= _VMEM_BUDGET:
+            return "pallas"
+    return "dense"
 
 
 def run_packed_auto(
@@ -79,9 +103,24 @@ def run_packed_auto(
             # to the exact XLA scan rather than failing the session.
             return run_packed(snap, weights=weights, gang_rounds=gang_rounds)
     if executor == "pallas":
+        from volcano_tpu.ops.blocked import run_packed_blocked
         from volcano_tpu.ops.pallas_session import run_packed_pallas
 
-        return run_packed_pallas(snap, weights=weights, gang_rounds=gang_rounds)
+        try:
+            return run_packed_pallas(
+                snap, weights=weights, gang_rounds=gang_rounds
+            )
+        except Exception as e:  # noqa: BLE001 — e.g. VMEM overflow at lowering
+            # Degrade to the exact blocked formulation, mirroring the
+            # native-path RuntimeError degradation below (ADVICE r2).
+            from volcano_tpu.utils.logging import get_logger
+
+            get_logger(__name__).error(
+                "pallas allocate failed (%s); blocked fallback", e
+            )
+            return run_packed_blocked(
+                snap, weights=weights, gang_rounds=gang_rounds
+            )
     if executor == "blocked":
         from volcano_tpu.ops.blocked import run_packed_blocked
 

@@ -434,7 +434,14 @@ def _feasibility_classes(snap: PackedSnapshot):
     50k tasks and this runs on every session.  Class order differs from
     the lexicographic row order but class identity (what the kernel
     consumes) is the same.
+
+    Memoized on the snapshot object: the VMEM-budget gate in the
+    dispatcher and the kernel array preparation both need the classes,
+    and each runs once per session.
     """
+    cached = getattr(snap, "_feas_classes_cache", None)
+    if cached is not None:
+        return cached
     combined = np.concatenate([snap.task_sel_bits, snap.task_tol_bits], axis=1)
     T, Wc = combined.shape
     code = np.zeros(T, dtype=np.int64)
@@ -449,11 +456,13 @@ def _feasibility_classes(snap: PackedSnapshot):
     np.minimum.at(first, inverse, np.arange(T, dtype=np.int64))
     uniq = combined[first]
     W = snap.task_sel_bits.shape[1]
-    return (
+    result = (
         inverse.astype(np.int32),
         np.ascontiguousarray(uniq[:, :W]),
         np.ascontiguousarray(uniq[:, W:]),
     )
+    snap._feas_classes_cache = result
+    return result
 
 
 def run_packed(

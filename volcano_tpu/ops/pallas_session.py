@@ -437,6 +437,20 @@ def prepare_pallas_arrays(
     return arrays, T_act, NK
 
 
+def pallas_vmem_bytes(snap: PackedSnapshot, block_size: int = 256) -> int:
+    """Estimated VMEM footprint of the allocate kernel (inputs +
+    scratch), consulted by the dispatcher: the footprint scales with the
+    feasibility-class count C and node width NK, which task×node area
+    alone does not capture (ADVICE r2)."""
+    R = snap.task_resreq.shape[1]
+    NK = max(LANES, -(-max(snap.n_nodes, 1) // LANES) * LANES)
+    _, class_sel, _ = _feasibility_classes(snap)
+    C = class_sel.shape[0]
+    n_planes = C + (3 * R + 2) + 2 * R + (R + 1)  # cf + nd + maxal/allocpos + scratch
+    # task block streams as [TB, R+2] → tiled to 128 lanes, double-buffered
+    return n_planes * NK * 4 + 2 * block_size * LANES * 4
+
+
 def run_packed_pallas(
     snap: PackedSnapshot,
     weights: ScoreWeights = DEFAULT_WEIGHTS,

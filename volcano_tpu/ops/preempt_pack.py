@@ -143,8 +143,38 @@ def collect_preempt_work(ssn):
     return queues, starving, tasks, under_request
 
 
+#: The preemptable intersection the dense/Pallas formulations hardcode
+#: (priority ∩ gang, with conformance handled by the critical-victim
+#: filter below).  Sessions whose first enabled-preemptable tier differs
+#: would silently diverge — pack refuses them instead.
+_SUPPORTED_PREEMPTABLE = {"priority", "gang", "conformance"}
+
+
+def _check_preemptable_tiers(ssn) -> None:
+    """Raise unless the first tier with enabled preemptable plugins is
+    exactly the {priority, gang, conformance} intersection the dense
+    formulation encodes (ADVICE r2: fail loudly, not wrongly)."""
+    for tier in ssn.tiers:
+        enabled = {
+            p.name
+            for p in tier.plugins
+            if getattr(p, "enabled_preemptable")
+            and p.name in ssn.preemptable_fns
+        }
+        if enabled:
+            if enabled != _SUPPORTED_PREEMPTABLE:
+                raise ValueError(
+                    "dense preempt formulation supports preemptable tier "
+                    f"{sorted(_SUPPORTED_PREEMPTABLE)}, session has "
+                    f"{sorted(enabled)}"
+                )
+            return
+    raise ValueError("session has no enabled preemptable plugins")
+
+
 def pack_preempt_session(ssn) -> PreemptPacked:
     """Session → PreemptPacked (order replay happens here, host-side)."""
+    _check_preemptable_tiers(ssn)
     queues, starving, ptasks_by_job, under_request = collect_preempt_work(ssn)
 
     # job table over ALL session jobs (victims may belong to any)
@@ -189,12 +219,18 @@ def pack_preempt_session(ssn) -> PreemptPacked:
     # victims: Running tasks per node, in the host's eviction order —
     # inverse task order (priority asc, creation/uid desc), stable over
     # the uid-sorted preemptee list (preempt.py victims_queue)
+    from volcano_tpu.plugins.conformance import _is_critical
+
     vics = []
     for n in nodes:
         node_vics = [
             t
             for t in sorted(n.tasks.values(), key=lambda t: t.uid)
-            if t.status == TaskStatus.Running and t.job in ssn.jobs
+            if t.status == TaskStatus.Running
+            and t.job in ssn.jobs
+            # conformance veto applied at pack time: critical victims
+            # never enter the dense/device victim set (conformance.go:45-60)
+            and not _is_critical(t)
         ]
         node_vics = _order_stable(
             node_vics, lambda l, r: ssn.task_order_fn(r, l)

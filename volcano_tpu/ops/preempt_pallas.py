@@ -1,8 +1,9 @@
 """Pallas TPU kernel for the preempt session pass.
 
 Runs the ENTIRE in-queue preemption replay (the dense semantics of
-ops/preempt_pack.py `preempt_dense`, itself bindings-equivalent to the
-host PreemptAction) inside one ``pallas_call``:
+ops/preempt_pack.py ``preempt_dense``, itself bindings-equivalent to the
+host PreemptAction — reference pkg/scheduler/actions/preempt/
+preempt.go:45-276) inside one ``pallas_call``:
 
   * victims live as node-major planes — K slots per node, each slot a
     [NS, 128] plane, slot order within a node = the eviction order —
@@ -13,13 +14,25 @@ host PreemptAction) inside one ``pallas_call``:
     VMEM scratch across the whole grid;
   * the host-packed static schedule streams in through the grid
     pipeline; each slot is one of BEGIN/ATTEMPT/END (phase 1, statement
-    scoped) or BEGIN2/ATTEMPT2 (phase 2, under-request sweep), with the
-    statement rollback implemented as shadow-buffer save/restore;
+    scoped — statement.go:309-337 rollback implemented as shadow-buffer
+    save/restore) or BURN (phase 2, under-request sweep — see below);
   * node scores reuse the exact score block of the allocate kernel
     (pallas_session.score_planes) at static ``used`` — evict/pipeline
     never change it (see preempt_pack.py module doc).
 
-Slot kinds: 0 BEGIN1, 1 ATTEMPT1, 2 END1, 3 BEGIN2, 4 ATTEMPT2, 9 pad.
+Slot kinds: 0 BEGIN1, 1 ATTEMPT1, 2 END1, 5 BURN2, 9 pad.
+
+Phase 2 (the under-request intra-job sweep, preempt.go:146-175)
+compiles to a single BURN slot per (queue, job): under the supported
+preemptable tier ({priority, gang, conformance} — enforced by
+pack_preempt_session), an intra-job attempt can NEVER evict (victims of
+the preemptor's own job have equal priority, and the priority plugin
+admits strictly-lower only), so the host loop's net effect is exactly
+"consume one pending task, break" — i.e. cursor += 1 when tasks remain.
+
+Equivalence is proven against ``preempt_dense`` (and transitively the
+host action) in tests/test_preempt_kernel.py; dispatch happens in
+actions/jax_preempt.py via ops.dispatch.select_preempt_executor.
 """
 
 from __future__ import annotations
@@ -34,46 +47,53 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from volcano_tpu.ops.kernels import DEFAULT_WEIGHTS, ScoreWeights
+from volcano_tpu.ops.kernels import (
+    DEFAULT_WEIGHTS,
+    ScoreWeights,
+    _feasibility_classes,
+)
 from volcano_tpu.ops.pallas_session import LANES, score_planes
 from volcano_tpu.ops.preempt_pack import PreemptPacked
 
 INT_BIG = np.int32(2**31 - 1)
 
-K_BEGIN1, K_ATT1, K_END1, K_BEGIN2, K_ATT2, K_PAD = 0, 1, 2, 3, 4, 9
+K_BEGIN1, K_ATT1, K_END1, K_BURN2, K_PAD = 0, 1, 2, 5, 9
 
 
 def _make_preempt_kernel(
-    R: int, K: int, NS: int, JS: int, PS: int, SB: int, C: int,
+    R: int, K: int, NS: int, JS: int, PS: int, SB: int,
     weights: ScoreWeights,
 ):
+    """Kernel factory — R resource lanes, K victim slots per node, NS node
+    sublanes, JS job sublanes, PS preemptor sublanes, SB schedule slots
+    per grid step."""
     shape = (NS, LANES)
 
     def kernel(
         tol_ref,  # SMEM [1, R]
-        sched_ref,  # VMEM [SB, 4] i32 (grid-streamed)
-        ptask_ref,  # VMEM [P_pad, R+1] f32 — resreq lanes, class
-        cf_ref,  # VMEM [C, NS, 128] f32
-        used_ref,  # VMEM [R, NS, 128] f32 (static)
+        sched_ref,  # VMEM [SB, 4] i32 (grid-streamed): kind, job, task, pad
+        ptask_ref,  # VMEM [P_pad, R+1] f32 — resreq lanes, feas class
+        cf_ref,  # VMEM [C, NS, 128] f32 class feasibility (incl. node_ok)
+        used_ref,  # VMEM [R, NS, 128] f32 (static across the pass)
         alloc_ref,  # VMEM [R, NS, 128] f32
         maxal_ref,  # VMEM [R, NS, 128] f32
         allocpos_ref,  # VMEM [R, NS, 128] f32
-        fi0_ref,  # VMEM [R, NS, 128] f32
+        fi0_ref,  # VMEM [R, NS, 128] f32 — future_idle at session open
         naux_ref,  # VMEM [2, NS, 128] f32 — ncount0, nmax
-        vr_ref,  # VMEM [R*K, NS, 128] f32 — victim resreq
-        vjob_ref,  # VMEM [K, NS, 128] i32
-        vq_ref,  # VMEM [K, NS, 128] i32 — victim job's queue
-        vjp_ref,  # VMEM [K, NS, 128] f32 — victim job priority
+        vr_ref,  # VMEM [R*K, NS, 128] f32 — victim resreq (r*K + k)
+        vjob_ref,  # VMEM [K, NS, 128] i32 — victim's job row
+        vq_ref,  # VMEM [K, NS, 128] i32 — victim job's queue row
+        vjp_ref,  # VMEM [K, NS, 128] i32 — victim job priority
         vjmin_ref,  # VMEM [K, NS, 128] f32 — victim job min_available
         vinit_ref,  # VMEM [2*K, NS, 128] f32 — galw0 | alive0
-        jobsf_ref,  # VMEM [4, JS, 128] f32 — ready0, waiting0, minav, jprio
-        jobsi_ref,  # VMEM [1, JS, 128] i32 — cursor0
+        jobsf_ref,  # VMEM [3, JS, 128] f32 — ready0, waiting0, min_avail
+        jobsi_ref,  # VMEM [3, JS, 128] i32 — cursor0, jqueue, jprio
         evicted_out,  # out VMEM [K, NS, 128] i32
         pipelined_out,  # out VMEM [PS, 128] i32
         fi_s,  # scratch [R, NS, 128] f32
         ncnt_s,  # scratch [1, NS, 128] f32
         alive_s,  # scratch [K, NS, 128] f32
-        galw_s,  # scratch [K, NS, 128] f32
+        galw_s,  # scratch [K, NS, 128] f32 — gang allowance per victim
         evic_s,  # scratch [K, NS, 128] i32
         ready_s,  # scratch [1, JS, 128] f32
         wait_s,  # scratch [1, JS, 128] f32
@@ -87,7 +107,6 @@ def _make_preempt_kernel(
         ready_sh,  # shadow [1, JS, 128]
         wait_sh,  # shadow [1, JS, 128]
         pipe_sh,  # shadow [PS, 128] i32
-        ph2_ref,  # SMEM scratch (1, 1) i32
     ):
         i = pl.program_id(0)
         G = pl.num_programs(0)
@@ -103,7 +122,6 @@ def _make_preempt_kernel(
             wait_s[:] = jobsf_ref[1:2]
             cursor_s[:] = jobsi_ref[0:1]
             pipe_s[:] = jnp.full((PS, LANES), -1, jnp.int32)
-            ph2_ref[0, 0] = 0
 
         nmax = naux_ref[1]
         idxp = (
@@ -121,20 +139,24 @@ def _make_preempt_kernel(
         row_lane = jax.lax.broadcasted_iota(jnp.int32, (1, R + 1), 1)
         row4 = jax.lax.broadcasted_iota(jnp.int32, (1, 4), 1)
 
-        def jread(plane_ref, j):
-            jm = jidx == j
-            return jnp.sum(jnp.where(jm, plane_ref[0], 0.0))
+        # scalar reads from the job planes (one-hot sum — no SMEM scalar
+        # loads, same trick as the allocate kernel's task rows)
+        def jread_f(plane, j):
+            return jnp.sum(jnp.where(jidx == j, plane, 0.0))
 
-        def jread_i(plane_ref, j):
-            jm = jidx == j
-            return jnp.sum(jnp.where(jm, plane_ref[0], 0))
+        def jread_i(plane, j):
+            return jnp.sum(jnp.where(jidx == j, plane, 0))
+
+        def jqueue_of(j):
+            return jread_i(jobsi_ref[1], j)
+
+        def jprio_of(j):
+            return jread_i(jobsi_ref[2], j)
 
         def pipelined_job(j):
-            return jread(wait_s, j) + jread(ready_s, j) >= jread_jobsf(2, j)
-
-        def jread_jobsf(rowi, j):
-            jm = jidx == j
-            return jnp.sum(jnp.where(jm, jobsf_ref[rowi], 0.0))
+            return jread_f(wait_s[0], j) + jread_f(ready_s[0], j) >= jread_f(
+                jobsf_ref[2], j
+            )
 
         def save_shadow():
             fi_sh[:] = fi_s[:]
@@ -156,9 +178,10 @@ def _make_preempt_kernel(
             wait_s[:] = wait_sh[:]
             pipe_s[:] = pipe_sh[:]
 
-        def attempt(j, p, inter: bool):
-            """One _preempt try for preemptor task p of job j.  Returns
-            scalar bool: assigned."""
+        def attempt(j, p, inter):
+            """One _preempt try (preempt.go:181-259) for preemptor task p
+            of job j.  ``inter``: phase-1 cross-job filter (same queue,
+            different job) vs phase-2 intra-job filter."""
             trow = ptask_ref[pl.ds(p, 1), :]  # [1, R+1]
 
             def col(r):
@@ -166,23 +189,22 @@ def _make_preempt_kernel(
 
             rr = [col(r) for r in range(R)]
             cls = col(R).astype(jnp.int32)
-            pq = jread_jobsf(3, j) * 0  # placeholder; queue read below
-            pq = jnp.sum(jnp.where(jidx == j, jobsi_ref[0] * 0, 0))  # unused
-            pprio = jread_jobsf(3, j)
+            pprio = jprio_of(j)
 
-            # eligibility per slot k (priority ∩ gang ∩ filter)
+            # victim eligibility per slot k: alive ∩ gang allowance ∩
+            # strictly-lower job priority ∩ the phase's job/queue filter.
+            # Fixed at attempt start — mid-attempt evictions don't re-rank
+            # (matches the host: victims list snapshot per node).
             elig = []
             for k in range(K):
-                e = (alive_s[k] > 0.0) & (galw_s[k] > 0.0) & (
-                    vjp_ref[k] < pprio
-                )
+                e = (alive_s[k] > 0.0) & (galw_s[k] > 0.0) & (vjp_ref[k] < pprio)
                 if inter:
                     e = e & (vq_ref[k] == jqueue_of(j)) & (vjob_ref[k] != j)
                 else:
                     e = e & (vjob_ref[k] == j)
                 elig.append(e)
 
-            # per-node victim sums + counts
+            # per-node eligible-victim sums + counts
             vsum = []
             for r in range(R):
                 acc = None
@@ -195,7 +217,8 @@ def _make_preempt_kernel(
                 t = jnp.where(elig[k], 1.0, 0.0)
                 vcnt = t if vcnt is None else vcnt + t
 
-            # validation: victims exist + pod count + fi+victims fit
+            # validation (preempt.go:261-276): victims exist + pod-count
+            # headroom + resreq fits future_idle + all eligible victims
             okl = None
             for r in range(R):
                 lane_ok = rr[r] < fi_s[r] + vsum[r] + tol_ref[0, r]
@@ -209,6 +232,7 @@ def _make_preempt_kernel(
                 & okl
             )
 
+            # node scores at static used (kernels.py node_scores math)
             req = [rr[r] + used_ref[r] for r in range(R)]
             total = score_planes(
                 rr,
@@ -224,11 +248,11 @@ def _make_preempt_kernel(
             okm = jnp.isfinite(m)
             nstar = jnp.min(jnp.where(masked == m, idxp, INT_BIG))
 
-            assigned_flag = jnp.zeros((1, 1), jnp.int32)  # captured below
-
             @pl.when(okm)
             def _():
                 colmask = idxp == nstar
+                # evict in slot order until the preemptor fits — exactly
+                # the host's victims_queue drain (preempt.go:216-233)
                 cum = [jnp.zeros(shape, jnp.float32) for _ in range(R)]
                 for k in range(K):
                     notfit = None
@@ -237,19 +261,21 @@ def _make_preempt_kernel(
                         if r >= 2:
                             lane_bad = lane_bad & ~(rr[r] <= tol_ref[0, r])
                         notfit = lane_bad if notfit is None else notfit | lane_bad
-                    ev_k = elig[k] & colmask & notfit
+                    ev_k = elig[k] & colmask & notfit  # ≤1 true element
                     for r in range(R):
                         cum[r] = cum[r] + jnp.where(ev_k, vr_ref[r * K + k], 0.0)
                     alive_s[k] = jnp.where(ev_k, 0.0, alive_s[k])
                     evic_s[k] = jnp.where(ev_k, 1, evic_s[k])
-                    # job bookkeeping for the (single) evicted victim
                     ev_any = jnp.max(jnp.where(ev_k, 1, 0))
 
                     @pl.when(ev_any > 0)
                     def _():
+                        # gang bookkeeping for the evicted victim's job:
+                        # ready -= 1, refresh its victims' allowances
+                        # (gang.go:75-94 at the new ready count)
                         j_e = jnp.sum(jnp.where(ev_k, vjob_ref[k], 0))
                         ready_s[0] = ready_s[0] - jnp.where(jidx == j_e, 1.0, 0.0)
-                        rj = jread(ready_s, j_e)
+                        rj = jread_f(ready_s[0], j_e)
                         for k2 in range(K):
                             refreshed = jnp.where(
                                 (vjmin_ref[k2] == 1.0)
@@ -264,7 +290,8 @@ def _make_preempt_kernel(
                 for r in range(R):
                     fi_s[r] = fi_s[r] + cum[r]
 
-                # final fit at nstar
+                # final fit at nstar (guaranteed by validation, kept as
+                # the literal host check) → pipeline
                 fitp = None
                 for r in range(R):
                     lane_ok = rr[r] < fi_s[r] + tol_ref[0, r]
@@ -281,20 +308,11 @@ def _make_preempt_kernel(
                     wait_s[0] = wait_s[0] + jnp.where(jidx == j, 1.0, 0.0)
                     pipe_s[:] = jnp.where(pidx == p, nstar, pipe_s[:])
 
-                return None
+            # assigned ⟺ this task's pipelined entry got written (entries
+            # start at -1 and p is visited at most once per live attempt)
+            return jnp.max(jnp.where(pidx == p, pipe_s[:], -1)) >= 0
 
-            # assigned = okm & okfit — recompute cheaply: a task is
-            # assigned iff its pipelined entry got written
-            got = jnp.max(jnp.where(pidx == p, pipe_s[:], -1))
-            return got >= 0
-
-        def jqueue_of(j):
-            jm = jidx == j
-            return jnp.sum(jnp.where(jm, jq_plane, 0))
-
-        jq_plane = jobsi_ref[0] * 0  # replaced below — see note
-
-        # ---- slot loop ----
+        # ---- schedule slot loop ----
         def slot(s, _):
             srow = sched_ref[pl.ds(s, 1), :]  # [1, 4]
 
@@ -303,7 +321,7 @@ def _make_preempt_kernel(
 
             kind = scol(0)
             j = scol(1)
-            kabs = scol(2)
+            p = scol(2)
 
             @pl.when(kind == K_BEGIN1)
             def _():
@@ -311,13 +329,13 @@ def _make_preempt_kernel(
 
             @pl.when(kind == K_ATT1)
             def _():
-                cur = jread_i(cursor_s, j)
-                fire = (cur == kabs) & ~pipelined_job(j)
+                cur = jread_i(cursor_s[0], j)
+                fire = (cur == p) & ~pipelined_job(j)
 
                 @pl.when(fire)
                 def _():
                     cursor_s[0] = cursor_s[0] + jnp.where(jidx == j, 1, 0)
-                    attempt(j, kabs, inter=True)
+                    attempt(j, p, inter=True)
 
             @pl.when(kind == K_END1)
             def _():
@@ -325,23 +343,17 @@ def _make_preempt_kernel(
                 def _():
                     restore_shadow()
 
-            @pl.when(kind == K_BEGIN2)
+            @pl.when(kind == K_BURN2)
             def _():
-                ph2_ref[0, 0] = 1
+                # phase-2 sweep for one job: consume one pending task if
+                # any remain (see module docstring — the attempt itself
+                # provably fails under the supported tier, so only the
+                # cursor moves).  Slot col 2 carries job_ptask_end.
+                cur = jread_i(cursor_s[0], j)
 
-            @pl.when(kind == K_ATT2)
-            def _():
-                cur = jread_i(cursor_s, j)
-                fire = (cur == kabs) & (ph2_ref[0, 0] == 1)
-
-                @pl.when(fire)
+                @pl.when(cur < p)
                 def _():
                     cursor_s[0] = cursor_s[0] + jnp.where(jidx == j, 1, 0)
-                    ok = attempt(j, kabs, inter=False)
-
-                    @pl.when(~ok)
-                    def _():
-                        ph2_ref[0, 0] = 0
 
             return 0
 
@@ -353,3 +365,327 @@ def _make_preempt_kernel(
             pipelined_out[:] = pipe_s[:]
 
     return kernel
+
+
+def _node_plane(vals: np.ndarray, NK: int) -> np.ndarray:
+    """[N] → [NS, 128] f32/i32 plane (zero pad)."""
+    NS = NK // LANES
+    out = np.zeros(NK, dtype=vals.dtype)
+    n = min(NK, vals.shape[0])
+    out[:n] = vals[:n]
+    return out.reshape(NS, LANES)
+
+
+def build_schedule_slots(pk: PreemptPacked) -> np.ndarray:
+    """Expand pk.schedule (phase, job) rows into kernel slots [S, 4] i32.
+    Phase 1: BEGIN1, one ATT1 per job task offset (the cursor guard makes
+    consumed offsets no-ops), END1.  Phase 2: a single BURN slot per
+    (queue, job) carrying job_ptask_end in col 2 — see the module
+    docstring for why the under-request sweep reduces to a cursor burn."""
+    slots = []
+    for phase, j in pk.schedule:
+        s, e = int(pk.job_ptask_start[j]), int(pk.job_ptask_end[j])
+        if phase == 1:
+            slots.append((K_BEGIN1, j, 0, 0))
+            for p in range(s, e):
+                slots.append((K_ATT1, j, p, 0))
+            slots.append((K_END1, j, 0, 0))
+        else:
+            slots.append((K_BURN2, j, e, 0))
+    if not slots:
+        return np.zeros((0, 4), np.int32)
+    return np.array(slots, dtype=np.int32)
+
+
+def prepare_preempt_arrays(pk: PreemptPacked) -> Tuple[dict, dict, np.ndarray]:
+    """Host-side packing of a PreemptPacked into the kernel's plane
+    layout → (arrays, dims, vic_slot) where vic_slot[i] is victim i's
+    k-slot on its node (needed to unpack the evicted output planes)."""
+    base = pk.base
+    R = base.task_resreq.shape[1]
+    P = max(base.n_tasks, 1)
+    N = base.n_nodes
+    NK = max(LANES, -(-max(N, 1) // LANES) * LANES)
+    NS = NK // LANES
+    NV = min(NK, base.node_idle.shape[0])
+
+    # victim slots: k-th victim of each node, in eviction order (the
+    # order pack_preempt_session appended them)
+    V = pk.n_victims
+    per_node = np.zeros(NK, dtype=np.int64)
+    vic_slot = np.zeros(max(V, 1), dtype=np.int64)
+    for i in range(V):
+        n = int(pk.vic_node[i])
+        vic_slot[i] = per_node[n]
+        per_node[n] += 1
+    K = int(max(1, per_node.max(initial=1)))
+
+    vr = np.zeros((R * K, NS, LANES), dtype=np.float32)
+    vjob = np.zeros((K, NS, LANES), dtype=np.int32)
+    vq = np.full((K, NS, LANES), -2, dtype=np.int32)
+    vjp = np.zeros((K, NS, LANES), dtype=np.int32)
+    vjmin = np.zeros((K, NS, LANES), dtype=np.float32)
+    galw0 = np.zeros((K, NS, LANES), dtype=np.float32)
+    alive0 = np.zeros((K, NS, LANES), dtype=np.float32)
+    for i in range(V):
+        n = int(pk.vic_node[i])
+        k = int(vic_slot[i])
+        sub, lane = n // LANES, n % LANES
+        jrow = int(pk.vic_job[i])
+        for r in range(R):
+            vr[r * K + k, sub, lane] = pk.vic_resreq[i, r]
+        vjob[k, sub, lane] = jrow
+        vq[k, sub, lane] = pk.job_queue[jrow]
+        prio = int(np.clip(pk.job_prio[jrow], -(2**31), 2**31 - 1))
+        vjp[k, sub, lane] = prio
+        vjmin[k, sub, lane] = float(pk.job_min_avail[jrow])
+        alive0[k, sub, lane] = 1.0
+        ma, rd = int(pk.job_min_avail[jrow]), int(pk.job_ready0[jrow])
+        galw0[k, sub, lane] = 1.0 if (ma <= rd - 1 or ma == 1) else 0.0
+
+    # class feasibility planes (same construction as the allocate kernel)
+    task_cls, class_sel, class_tol = _feasibility_classes(base)
+    node_labels = base.node_label_bits[:NV]
+    node_taints = base.node_taint_bits[:NV]
+    sel_ok = ((class_sel[:, None, :] & ~node_labels[None, :, :]) == 0).all(-1)
+    tol_ok = ((node_taints[None, :, :] & ~class_tol[:, None, :]) == 0).all(-1)
+    C = class_sel.shape[0]
+    cf = np.zeros((C, NK), dtype=np.float32)
+    cf[:, :NV] = sel_ok & tol_ok & base.node_ok[None, :NV]
+
+    P_pad = -(-P // 8) * 8
+    ptask = np.zeros((P_pad, R + 1), dtype=np.float32)
+    n_copy = min(P_pad, base.task_resreq.shape[0])
+    ptask[:n_copy, :R] = base.task_resreq[:n_copy]
+    ptask[: min(P_pad, task_cls.shape[0]), R] = task_cls[
+        : min(P_pad, task_cls.shape[0])
+    ].astype(np.float32)
+
+    def planes(arr2d):  # [N_pad, R] → [R, NS, 128]
+        wide = np.zeros((NK, R), dtype=np.float32)
+        n = min(NK, arr2d.shape[0])
+        wide[:n] = arr2d[:n]
+        return np.ascontiguousarray(wide.T).reshape(R, NS, LANES)
+
+    alloc = planes(base.node_alloc)
+    used = planes(base.node_used)
+
+    J = max(pk.n_jobs, 1)
+    JS = -(-J // LANES)
+
+    def jplane(vals, dtype):
+        out = np.zeros(JS * LANES, dtype=dtype)
+        out[: vals.shape[0]] = vals
+        return out.reshape(JS, LANES)
+
+    jobsf = np.stack(
+        [
+            jplane(pk.job_ready0.astype(np.float32), np.float32),
+            jplane(pk.job_waiting0.astype(np.float32), np.float32),
+            jplane(pk.job_min_avail.astype(np.float32), np.float32),
+        ]
+    )
+    jobsi = np.stack(
+        [
+            jplane(pk.job_ptask_start.astype(np.int32), np.int32),
+            jplane(pk.job_queue.astype(np.int32), np.int32),
+            jplane(
+                np.clip(pk.job_prio, -(2**31), 2**31 - 1).astype(np.int32),
+                np.int32,
+            ),
+        ]
+    )
+
+    PS = -(-P // LANES)
+    arrays = dict(
+        tol=base.tolerance.reshape(1, R).astype(np.float32),
+        ptask=ptask,
+        cf=np.ascontiguousarray(cf.reshape(C, NS, LANES)),
+        used=used,
+        alloc=alloc,
+        maxal=np.maximum(alloc, 1.0),
+        allocpos=(alloc > 0.0).astype(np.float32),
+        fi0=planes(pk.node_fi0),
+        naux=np.stack(
+            [
+                _node_plane(base.node_task_count.astype(np.float32), NK),
+                _node_plane(base.node_max_tasks.astype(np.float32), NK),
+            ]
+        ),
+        vr=vr,
+        vjob=vjob,
+        vq=vq,
+        vjp=vjp,
+        vjmin=vjmin,
+        vinit=np.concatenate([galw0, alive0]),
+    )
+    arrays["jobsf"] = jobsf
+    arrays["jobsi"] = jobsi
+    dims = dict(R=R, K=K, NS=NS, JS=JS, PS=PS, C=C, NK=NK)
+    return arrays, dims, vic_slot
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "R", "K", "NS", "JS", "PS", "SB", "weights", "interpret"
+    ),
+)
+def _preempt_call(
+    tol, sched, ptask, cf, used, alloc, maxal, allocpos, fi0, naux,
+    vr, vjob, vq, vjp, vjmin, vinit, jobsf, jobsi,
+    R, K, NS, JS, PS, SB, weights, interpret,
+):
+    S = sched.shape[0]
+    G = S // SB
+    kernel = _make_preempt_kernel(R, K, NS, JS, PS, SB, weights)
+    C = cf.shape[0]
+
+    full = lambda *shape: pl.BlockSpec(
+        shape, lambda i: tuple(0 for _ in shape), memory_space=pltpu.VMEM
+    )
+    evicted, pipelined = pl.pallas_call(
+        kernel,
+        grid=(G,),
+        in_specs=[
+            pl.BlockSpec((1, R), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((SB, 4), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            full(*ptask.shape),
+            full(C, NS, LANES),
+            full(R, NS, LANES),
+            full(R, NS, LANES),
+            full(R, NS, LANES),
+            full(R, NS, LANES),
+            full(R, NS, LANES),
+            full(2, NS, LANES),
+            full(R * K, NS, LANES),
+            full(K, NS, LANES),
+            full(K, NS, LANES),
+            full(K, NS, LANES),
+            full(K, NS, LANES),
+            full(2 * K, NS, LANES),
+            full(3, JS, LANES),
+            full(3, JS, LANES),
+        ],
+        out_specs=[
+            full(K, NS, LANES),
+            full(PS, LANES),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K, NS, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((PS, LANES), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((R, NS, LANES), jnp.float32),
+            pltpu.VMEM((1, NS, LANES), jnp.float32),
+            pltpu.VMEM((K, NS, LANES), jnp.float32),
+            pltpu.VMEM((K, NS, LANES), jnp.float32),
+            pltpu.VMEM((K, NS, LANES), jnp.int32),
+            pltpu.VMEM((1, JS, LANES), jnp.float32),
+            pltpu.VMEM((1, JS, LANES), jnp.float32),
+            pltpu.VMEM((1, JS, LANES), jnp.int32),
+            pltpu.VMEM((PS, LANES), jnp.int32),
+            pltpu.VMEM((R, NS, LANES), jnp.float32),
+            pltpu.VMEM((1, NS, LANES), jnp.float32),
+            pltpu.VMEM((K, NS, LANES), jnp.float32),
+            pltpu.VMEM((K, NS, LANES), jnp.float32),
+            pltpu.VMEM((K, NS, LANES), jnp.int32),
+            pltpu.VMEM((1, JS, LANES), jnp.float32),
+            pltpu.VMEM((1, JS, LANES), jnp.float32),
+            pltpu.VMEM((PS, LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        tol, sched, ptask, cf, used, alloc, maxal, allocpos, fi0, naux,
+        vr, vjob, vq, vjp, vjmin, vinit, jobsf, jobsi,
+    )
+    return evicted, pipelined
+
+
+def preempt_vmem_bytes(pk: PreemptPacked) -> int:
+    """Estimated kernel VMEM footprint (inputs + scratch + shadows), used
+    by the dispatcher to gate the Pallas route."""
+    base = pk.base
+    R = base.task_resreq.shape[1]
+    N = max(base.n_nodes, 1)
+    NK = max(LANES, -(-N // LANES) * LANES)
+    per_node = np.bincount(
+        pk.vic_node[: pk.n_victims], minlength=1
+    ) if pk.n_victims else np.zeros(1, np.int64)
+    K = int(max(1, per_node.max(initial=1)))
+    J = max(pk.n_jobs, 1)
+    JS = -(-J // LANES)
+    P = max(base.n_tasks, 1)
+    PS = -(-P // LANES)
+    task_cls, class_sel, _ = _feasibility_classes(base)
+    C = class_sel.shape[0]
+    plane = NK * 4
+    n_planes = (
+        C + 5 * R + 2  # cf + used/alloc/maxal/allocpos/fi0 + naux
+        + R * K + 6 * K  # victim planes (vr, vjob/vq/vjp/vjmin, vinit×2)
+        + (R + 1 + 3 * K) * 2  # node scratch + shadows
+    )
+    job_planes = (3 + 3 + 3 * 2) * JS * LANES * 4
+    pipe = 2 * PS * LANES * 4
+    ptask = P * LANES * 4  # [P_pad, R+1] tiles to 128 lanes
+    return n_planes * plane + job_planes + pipe + ptask + K * plane
+
+
+def run_preempt_pallas(
+    pk: PreemptPacked,
+    weights: ScoreWeights = DEFAULT_WEIGHTS,
+    block_slots: int = 1024,
+    interpret: bool = False,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """PreemptPacked → (evicted[V] bool, pipelined_node[P] i32, -1=none).
+
+    Packs to planes, makes ONE device call that replays the whole
+    preempt pass, unpacks.  Semantics ≡ preempt_dense ≡ host action."""
+    base = pk.base
+    P = base.n_tasks
+    V = pk.n_victims
+    evicted = np.zeros(max(V, 1), dtype=bool)[:V]
+    pipelined = np.full(max(P, 1), -1, dtype=np.int32)[:P]
+    slots = build_schedule_slots(pk)
+    if P == 0 or slots.shape[0] == 0:
+        return evicted, pipelined
+
+    arrays, dims, vic_slot = prepare_preempt_arrays(pk)
+    S = slots.shape[0]
+    SB = min(block_slots, -(-S // 8) * 8)
+    S_pad = -(-S // SB) * SB
+    sched = np.full((S_pad, 4), 0, dtype=np.int32)
+    sched[:, 0] = K_PAD
+    sched[:S] = slots
+
+    ev_planes, pipe_planes = _preempt_call(
+        jnp.asarray(arrays["tol"]),
+        jnp.asarray(sched),
+        jnp.asarray(arrays["ptask"]),
+        jnp.asarray(arrays["cf"]),
+        jnp.asarray(arrays["used"]),
+        jnp.asarray(arrays["alloc"]),
+        jnp.asarray(arrays["maxal"]),
+        jnp.asarray(arrays["allocpos"]),
+        jnp.asarray(arrays["fi0"]),
+        jnp.asarray(arrays["naux"]),
+        jnp.asarray(arrays["vr"]),
+        jnp.asarray(arrays["vjob"]),
+        jnp.asarray(arrays["vq"]),
+        jnp.asarray(arrays["vjp"]),
+        jnp.asarray(arrays["vjmin"]),
+        jnp.asarray(arrays["vinit"]),
+        jnp.asarray(arrays["jobsf"]),
+        jnp.asarray(arrays["jobsi"]),
+        R=dims["R"], K=dims["K"], NS=dims["NS"], JS=dims["JS"],
+        PS=dims["PS"], SB=SB, weights=weights, interpret=interpret,
+    )
+    ev_planes = np.asarray(ev_planes)
+    pipe_flat = np.asarray(pipe_planes).reshape(-1)
+
+    if V:
+        sub = pk.vic_node[:V] // LANES
+        lane = pk.vic_node[:V] % LANES
+        evicted = ev_planes[vic_slot[:V], sub, lane] > 0
+    pipelined = pipe_flat[:P].astype(np.int32)
+    return evicted, pipelined
