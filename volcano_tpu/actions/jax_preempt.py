@@ -87,10 +87,9 @@ class JaxPreemptAction(Action):
             return
 
         evicted, pipelined = self._device_outcome(pk)
-        metrics.update_preemption_victims_count(int(evicted.sum()))
-        metrics.register_preemption_attempts()
 
         if not evicted.any() and not (pipelined >= 0).any():
+            metrics.register_preemption_attempts()
             return
 
         stmt = ssn.statement()
@@ -116,10 +115,15 @@ class JaxPreemptAction(Action):
                     raise FitError(task, node, "device fit diverged")
                 stmt.pipeline(task, node.name)
         except FitError as e:
+            # Fall back WITHOUT recording metrics here — the host action
+            # records its own attempts/victims (no double count).
             log.error("device preempt apply diverged (%s); host fallback", e)
             stmt.discard()
             PreemptAction().execute(ssn)
             return
+        # committed — record what actually happened
+        metrics.update_preemption_victims_count(int(evicted.sum()))
+        metrics.register_preemption_attempts()
         stmt.commit()
 
 

@@ -66,6 +66,88 @@ def _block_scores(weights, tolerance, base, node_alloc, node_max_tasks,
     return jnp.where(feasible, score, -jnp.inf)
 
 
+def make_inner_step(tracked, base_t, alloc_t, maxt_t, real, tolerance,
+                    weights, R):
+    """The per-task decision body for resolving one block over compact
+    tracked slots — the SINGLE copy shared by the single-chip kernel
+    below and the sharded mesh kernel (ops/sharded.py), so tie-break /
+    tolerance / stop-rule fixes propagate to both.
+
+    ``tracked`` must be sorted ascending by node id (global id for the
+    sharded path) so that argmax-first IS the lowest-node-index
+    tie-break; dummy slots carry ``real=False`` and the largest ids.
+    Scan xs: (resreq, tf_row, out_max_b, out_arg_b, act)."""
+
+    def inner(carry, xs):
+        U, stopped = carry
+        resreq, tf_row, out_max_b, out_arg_b, act = xs
+
+        u = U[:, :-1]
+        cnt = U[:, -1]
+        idle_t = base_t - u
+        # Unrolled lane reduce (R is small and static; avoids a reduce
+        # op per step — per-op scan overhead dominates).
+        fit = jnp.ones((u.shape[0],), bool)
+        for r in range(R):
+            lane_ok = resreq[r] < idle_t[:, r] + tolerance[r]
+            if r >= 2:
+                lane_ok = lane_ok | (resreq[r] <= tolerance[r])
+            fit = fit & lane_ok
+        feas = fit & (cnt < maxt_t) & tf_row & act & real
+        s = node_scores(resreq[None, :], u, alloc_t, weights)[0]
+        s = jnp.where(feas, s, -jnp.inf)
+
+        # tracked is SORTED ascending, so the first max position is the
+        # lowest node index among maxima — one argmax does both the max
+        # and the tie-break.
+        pos = jnp.argmax(s)
+        maxv = s[pos]
+        t_ok = jnp.isfinite(maxv)
+        t_node = tracked[pos]
+
+        out_finite = jnp.isfinite(out_max_b)
+        outside_better = out_finite & (
+            (out_max_b > maxv) | ((out_max_b == maxv) & (out_arg_b < t_node))
+        )
+
+        place = t_ok & ~outside_better & ~stopped
+        stop_now = ~stopped & outside_better
+        consumed = ~stopped & ~stop_now
+
+        U = U.at[pos].add(
+            jnp.where(place, 1.0, 0.0)
+            * jnp.concatenate([resreq, jnp.ones((1,), resreq.dtype)])
+        )
+        chosen = jnp.where(place, t_node, -1)
+        return (U, stopped | stop_now), (chosen, consumed)
+
+    return inner
+
+
+def gang_fixpoint(run_pass, task_job, job_min_available, job_ready_count,
+                  n_tasks, t_total, gang_rounds):
+    """Adaptive host-side gang commit/discard loop (run_packed protocol),
+    shared by the blocked and sharded wrappers: ``run_pass(active)`` →
+    (chosen, job_assigned); stops as soon as the active set is stable."""
+    active = np.zeros(t_total, dtype=bool)
+    active[:n_tasks] = True
+    min_avail = job_min_available.astype(np.int64)
+    ready_count = job_ready_count.astype(np.int64)
+
+    chosen_np = np.full(t_total, -1, dtype=np.int32)
+    committed = np.zeros(t_total, dtype=bool)
+    for _ in range(gang_rounds):
+        chosen, job_assigned = run_pass(jnp.asarray(active))
+        chosen_np = np.asarray(chosen)
+        ready = np.asarray(job_assigned, dtype=np.int64) + ready_count >= min_avail
+        committed = ready[task_job] & (chosen_np >= 0)
+        next_active = active & ready[task_job]
+        if (next_active == active).all():
+            break
+        active = next_active
+    return np.where(committed & active, chosen_np, -1)[:n_tasks]
+
+
 @functools.partial(
     jax.jit, static_argnames=("weights", "block_size", "top_k")
 )
@@ -154,51 +236,10 @@ def schedule_pass_blocked(
         maxt_t = node_max_tasks[tracked]
         real = tracked != SENTINEL  # sentinel slots never place
         tf_blk = cf_blk[:, tracked]  # [B, M] static feas on tracked
-        scalar_lane = jnp.arange(R) >= 2
 
-        def inner(carry, xs):
-            U, stopped = carry
-            resreq, tf_row, out_max_b, out_arg_b, act = xs
-
-            u = U[:, :-1]
-            cnt = U[:, -1]
-            idle_t = base_t - u
-            # Unrolled lane reduce (R is small and static; avoids a
-            # reduce op per step — per-op scan overhead dominates).
-            fit = jnp.ones((u.shape[0],), bool)
-            for r in range(R):
-                lane_ok = resreq[r] < idle_t[:, r] + tolerance[r]
-                if r >= 2:
-                    lane_ok = lane_ok | (resreq[r] <= tolerance[r])
-                fit = fit & lane_ok
-            feas = fit & (cnt < maxt_t) & tf_row & act & real
-            s = node_scores(resreq[None, :], u, alloc_t, weights)[0]
-            s = jnp.where(feas, s, -jnp.inf)
-
-            # tracked is SORTED ascending, so the first max position is
-            # the lowest node index among maxima — one argmax does both
-            # the max and the tie-break.
-            pos = jnp.argmax(s)
-            maxv = s[pos]
-            t_ok = jnp.isfinite(maxv)
-            t_node = tracked[pos]
-
-            out_finite = jnp.isfinite(out_max_b)
-            outside_better = out_finite & (
-                (out_max_b > maxv) | ((out_max_b == maxv) & (out_arg_b < t_node))
-            )
-
-            place = t_ok & ~outside_better & ~stopped
-            stop_now = ~stopped & outside_better
-            consumed = ~stopped & ~stop_now
-
-            U = U.at[pos].add(
-                jnp.where(place, 1.0, 0.0)
-                * jnp.concatenate([resreq, jnp.ones((1,), resreq.dtype)])
-            )
-            chosen = jnp.where(place, t_node, -1)
-            return (U, stopped | stop_now), (chosen, consumed)
-
+        inner = make_inner_step(
+            tracked, base_t, alloc_t, maxt_t, real, tolerance, weights, R
+        )
         (U, _), (chosen_blk, consumed_blk) = jax.lax.scan(
             inner,
             (U0, jnp.zeros((), bool)),
@@ -316,17 +357,8 @@ def run_packed_blocked(
     arrays, T_blk = prepare_blocked_arrays(snap, block_size)
     dev = {k: jnp.asarray(v) for k, v in arrays.items()}
 
-    active = np.zeros(T_blk, dtype=bool)
-    active[: snap.n_tasks] = True
-
-    task_job = arrays["task_job"]
-    min_avail = snap.job_min_available.astype(np.int64)
-    ready_count = snap.job_ready_count.astype(np.int64)
-
-    chosen_np = np.full(T_blk, -1, dtype=np.int32)
-    committed = np.zeros(T_blk, dtype=bool)
-    for _ in range(gang_rounds):
-        chosen, job_assigned = schedule_pass_blocked(
+    def run_pass(active):
+        return schedule_pass_blocked(
             dev["task_resreq"],
             dev["task_job"],
             dev["task_feas_class"],
@@ -342,18 +374,18 @@ def run_packed_blocked(
             dev["node_max_tasks"],
             dev["job_min_available"],
             dev["tolerance"],
-            jnp.asarray(active),
+            active,
             weights=weights,
             block_size=block_size,
             top_k=top_k,
         )
-        chosen_np = np.asarray(chosen)
-        ready = np.asarray(job_assigned, dtype=np.int64) + ready_count >= min_avail
-        committed = ready[task_job] & (chosen_np >= 0)
-        next_active = active & ready[task_job]
-        if (next_active == active).all():
-            break
-        active = next_active
 
-    assignment = np.where(committed & active, chosen_np, -1)
-    return assignment[: snap.n_tasks]
+    return gang_fixpoint(
+        run_pass,
+        arrays["task_job"],
+        snap.job_min_available,
+        snap.job_ready_count,
+        snap.n_tasks,
+        T_blk,
+        gang_rounds,
+    )

@@ -228,9 +228,10 @@ def step_feasible_score(
     feas_row,
     active,
 ):
-    """Per-step feasibility + masked score — the SINGLE copy of the
-    scheduling semantics, shared by the single-chip scan step below and
-    the sharded scan step (ops/sharded.py).  Sub-tolerance skip on scalar
+    """Per-step feasibility + masked score for the single-chip scan step
+    below.  (The blocked/sharded kernels use the same semantics through
+    blocked._block_scores / blocked.make_inner_step; the sharded mesh
+    kernel no longer consumes this helper.)  Sub-tolerance skip on scalar
     lanes only (see predicate_mask)."""
     used = used_ext[:, :-1]
     count = used_ext[:, -1]

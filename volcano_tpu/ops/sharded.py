@@ -1,17 +1,38 @@
-"""Multi-chip session kernel: node axis sharded over a device mesh.
+"""Multi-chip session kernel: node axis sharded over a device mesh,
+blocked formulation (one collective round per task-BLOCK, not per task).
 
 Scale-out design (SURVEY.md §5 "long-context" analogue): the session's
 scale axis is tasks × nodes.  Tasks are a sequential scan (allocation
-feedback), so the parallel axis is nodes — each device owns a contiguous
-node shard, evaluates predicate+score locally via the SAME
-step_feasible_score helper as the single-chip kernel, and the winner is
-reduced with one tiny all-gather of (score, local-argmax) pairs per step.
-Only O(n_devices) scalars cross ICI per step.
+feedback), so the parallel axis is nodes.  The round-1/2 formulation ran
+one full-width step + one all_gather per task — 50k ICI collectives at
+the headline shape, the exact per-step-overhead design the single-chip
+path escaped.  This version shards the BLOCKED formulation
+(ops/blocked.py) instead:
 
-Deterministic tie-break is preserved: each shard argmax picks its first
-(lowest-local-index) maximum, and the cross-shard reduction picks the
-lowest shard among equal maxima — together the globally lowest node index,
-identical to the single-chip kernel and the host path.
+  1. Per block of B tasks, each device computes [B, N_loc] feasibility +
+     scores at block-start state over its LOCAL node shard (the wide,
+     MXU-friendly part — this is what sharding is for), takes local
+     top-K candidates per task plus the local outside max/argmax.
+  2. ONE all-gather round ships the tiny candidate pack (ids, state
+     rows, static planes, outside pairs) — O(B·K·R) scalars per device.
+  3. Every device then runs the IDENTICAL replicated inner scan over the
+     gathered M = n_dev·B·K candidate slots (sorted by global node id,
+     so argmax-first = lowest-global-index tie-break), resolving the
+     block task-by-task with the same exactness invariant as
+     ops/blocked.py: placements land only on tracked slots, untracked
+     nodes keep block-start scores, and the outside comparison is exact
+     — if an untracked node would win, the block STOPS and that one
+     task is resolved full-width (one extra collective, rare).
+  4. Each device writes back the slot rows it owns; state never leaves
+     the owning shard except as gathered candidates.
+
+Deterministic tie-break is preserved end-to-end: candidate slots are
+sorted by TRUE global node index before the replicated scan, the
+outside argmax carries the lowest global index achieving the max, and
+the full-width fallback reduces (score, lowest-local) pairs picking the
+lowest shard among equal maxima — identical bindings to run_packed /
+run_packed_blocked / the Pallas kernel (tests/test_sharded.py asserts
+this at 10k nodes on an 8-virtual-device mesh).
 """
 
 from __future__ import annotations
@@ -23,27 +44,26 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from volcano_tpu.ops.blocked import _block_scores, gang_fixpoint, make_inner_step
 from volcano_tpu.ops.kernels import (
     DEFAULT_WEIGHTS,
-    MAX_PRIORITY,
     ScoreWeights,
     _feasibility_classes,
     f32_lr_exact,
-    step_delta_ext,
-    step_feasible_score,
 )
 from volcano_tpu.ops.packing import PackedSnapshot
 
 AXIS = "nodes"
+INT_BIG = np.int32(2**31 - 1)
 
 
-def _sharded_kernel(
-    task_resreq,
-    task_job,
-    task_feas_class,  # [T]
+def _sharded_blocked_kernel(
+    task_resreq,  # [T_blk, R] replicated
+    task_job,  # [T_blk]
+    task_feas_class,  # [T_blk]
     class_sel_bits,  # [C, W] replicated
-    class_tol_bits,  # [C, W] replicated
-    node_idle,  # local shard [N_loc, R]
+    class_tol_bits,  # [C, W]
+    node_idle,  # local shard [n_loc1, R] (last row = dummy)
     node_used,
     node_alloc,
     node_label_bits,
@@ -52,92 +72,205 @@ def _sharded_kernel(
     node_task_count,
     node_max_tasks,
     job_min_available,
-    job_ready_count,
     tolerance,
-    task_valid,
+    active,  # [T_blk] replicated
     weights: ScoreWeights,
-    gang_rounds: int,
+    block_size: int,
+    top_k: int,
 ):
-    """Body run under shard_map: node-sharded arrays are the local chunk."""
-    my_shard = jax.lax.axis_index(AXIS)
-    n_local = node_idle.shape[0]
+    """shard_map body: one blocked greedy pass → (chosen[T_blk] global
+    node ids, job_assigned).  All replicated values evolve identically on
+    every shard (inputs to the replicated scan are gathered, hence
+    bit-identical)."""
+    my = jax.lax.axis_index(AXIS)
+    n_dev = jax.lax.axis_size(AXIS)
+    n_loc1 = node_idle.shape[0]
+    n_loc = n_loc1 - 1  # real rows; row n_loc is the infeasible dummy
+    T = task_resreq.shape[0]
+    R = task_resreq.shape[1]
+    B = block_size
+    K = min(top_k, n_loc1)  # tiny shards can't track more than they own
+    DUMMY_LOCAL = jnp.int32(n_loc)
 
-    # Class-level static feasibility against the local node shard [C, N_loc].
     sel_ok = jnp.all(
         (class_sel_bits[:, None, :] & ~node_label_bits[None, :, :]) == 0, axis=-1
     )
     tol_ok = jnp.all(
         (node_taint_bits[None, :, :] & ~class_tol_bits[:, None, :]) == 0, axis=-1
     )
-    class_feasible = sel_ok & tol_ok & node_ok[None, :]
+    class_feasible = sel_ok & tol_ok & node_ok[None, :]  # [C, n_loc1]
 
     base = node_idle + node_used
     used_ext0 = jnp.concatenate(
         [node_used, node_task_count.astype(node_used.dtype)[:, None]], axis=1
     )
 
-    def one_pass(active):
-        def step(state, task):
-            used_ext, job_assigned = state
-            resreq, feas_cls, job_idx, act = task
+    def to_global(local_idx):
+        """Local row → true global node id (dummy → INT_BIG)."""
+        return jnp.where(
+            local_idx >= n_loc, INT_BIG, my * n_loc + local_idx
+        ).astype(jnp.int32)
 
-            feasible, score = step_feasible_score(
-                weights, tolerance, base, node_alloc, node_max_tasks,
-                used_ext, resreq, class_feasible[feas_cls], act,
-            )
-            best_local = jnp.argmax(score)
-            best_score = score[best_local]
-
-            # Cross-shard reduction: lowest shard index among max scores.
-            all_scores = jax.lax.all_gather(best_score, AXIS)  # [n_shards]
-            all_locals = jax.lax.all_gather(best_local, AXIS)
-            winner = jnp.argmax(all_scores)  # first max → lowest shard
-            ok = jnp.isfinite(all_scores[winner])
-
-            mine = (winner == my_shard) & ok
-            used_ext = used_ext.at[best_local].add(step_delta_ext(resreq, mine))
-            job_assigned = job_assigned.at[job_idx].add(jnp.where(ok, 1, 0))
-
-            chosen = jnp.where(ok, winner * n_local + all_locals[winner], -1)
-            return (used_ext, job_assigned), chosen
-
-        init = (used_ext0, jnp.zeros_like(job_min_available))
-        final, chosen = jax.lax.scan(
-            step, init, (task_resreq, task_feas_class, task_job, active)
+    def full_step(used_ext, resreq, cls, act):
+        """Exact single-task step at full width — the stop-task resolver.
+        One (score, global-argmax) all-gather; lowest shard among equal
+        maxima wins, preserving the global lowest-index tie-break."""
+        s = _block_scores(
+            weights, tolerance, base, node_alloc, node_max_tasks,
+            used_ext, resreq[None, :], class_feasible[cls][None, :], act[None],
+        )[0]
+        best_local = jnp.argmax(s)  # first max → lowest local index
+        best_score = s[best_local]
+        all_scores = jax.lax.all_gather(best_score, AXIS)  # [n_dev]
+        all_globals = jax.lax.all_gather(to_global(best_local), AXIS)
+        winner = jnp.argmax(all_scores)  # first max → lowest shard
+        ok = jnp.isfinite(all_scores[winner])
+        mine = (winner == my) & ok
+        delta = jnp.concatenate([resreq, jnp.ones((1,), resreq.dtype)])
+        used_ext = used_ext.at[best_local].add(
+            jnp.where(mine, 1.0, 0.0) * delta
         )
-        return final, chosen
+        chosen = jnp.where(ok, all_globals[winner], -1)
+        return used_ext, chosen
 
-    def round_body(carry, _):
-        active, _, _ = carry
-        final, chosen = one_pass(active)
-        ready = final[1] + job_ready_count >= job_min_available
-        committed = ready[task_job] & (chosen >= 0)
-        next_active = active & ready[task_job]
-        return (next_active, chosen, committed), None
+    def run_block(used_ext, cursor):
+        resreq_blk = jax.lax.dynamic_slice(task_resreq, (cursor, 0), (B, R))
+        cls_blk = jax.lax.dynamic_slice(task_feas_class, (cursor,), (B,))
+        act_blk = jax.lax.dynamic_slice(active, (cursor,), (B,))
 
-    carry0 = (task_valid, jnp.full_like(task_job, -1), jnp.zeros_like(task_valid))
-    (active, chosen, committed), _ = jax.lax.scan(
-        round_body, carry0, None, length=gang_rounds
+        cf_blk = class_feasible[cls_blk]  # [B, n_loc1]
+        S = _block_scores(
+            weights, tolerance, base, node_alloc, node_max_tasks,
+            used_ext, resreq_blk, cf_blk, act_blk,
+        )  # [B, n_loc1]
+
+        _, top_idx = jax.lax.top_k(S, K)  # [B, K] local indices
+        flat = jnp.sort(top_idx.reshape(-1).astype(jnp.int32))
+        dup = jnp.concatenate([jnp.zeros((1,), bool), flat[1:] == flat[:-1]])
+        tracked_loc = jnp.where(dup, DUMMY_LOCAL, flat)  # [M_loc]
+
+        in_tracked = jnp.zeros((n_loc1,), bool).at[tracked_loc].set(True)
+        S_out = jnp.where(in_tracked[None, :], -jnp.inf, S)
+        out_max_loc = jnp.max(S_out, axis=1)  # [B]
+        out_arg_loc = to_global(jnp.argmax(S_out, axis=1).astype(jnp.int32))
+
+        # ---- gather the candidate pack (the one collective round) ----
+        ids_g = jax.lax.all_gather(to_global(tracked_loc), AXIS).reshape(-1)
+        U_g = jax.lax.all_gather(used_ext[tracked_loc], AXIS).reshape(-1, R + 1)
+        base_g = jax.lax.all_gather(base[tracked_loc], AXIS).reshape(-1, R)
+        alloc_g = jax.lax.all_gather(node_alloc[tracked_loc], AXIS).reshape(-1, R)
+        maxt_g = jax.lax.all_gather(node_max_tasks[tracked_loc], AXIS).reshape(-1)
+        tf_g = jax.lax.all_gather(
+            cf_blk[:, tracked_loc], AXIS, axis=1
+        ).reshape(B, -1)
+        out_max_all = jax.lax.all_gather(out_max_loc, AXIS)  # [n_dev, B]
+        out_arg_all = jax.lax.all_gather(out_arg_loc, AXIS)  # [n_dev, B]
+
+        # global outside: max score, lowest global id among shard maxima
+        out_max = jnp.max(out_max_all, axis=0)  # [B]
+        out_arg = jnp.min(
+            jnp.where(out_max_all == out_max[None, :], out_arg_all, INT_BIG),
+            axis=0,
+        )
+
+        # sort slots by global id → argmax-first = lowest-global-index
+        perm = jnp.argsort(ids_g)
+        tracked = ids_g[perm]  # [M_g], dummies (INT_BIG) at the end
+        U0 = U_g[perm]
+        base_t = base_g[perm]
+        alloc_t = alloc_g[perm]
+        maxt_t = maxt_g[perm]
+        tf_blk_g = tf_g[:, perm]
+        real = tracked != INT_BIG
+
+        # the per-task decision body is the SAME code object as the
+        # single-chip blocked kernel's (blocked.make_inner_step) — the
+        # bindings-equivalence invariant cannot drift between them
+        inner = make_inner_step(
+            tracked, base_t, alloc_t, maxt_t, real, tolerance, weights, R
+        )
+        (U, _), (chosen_blk, consumed_blk) = jax.lax.scan(
+            inner,
+            (U0, jnp.zeros((), bool)),
+            (resreq_blk, tf_blk_g, out_max, out_arg, act_blk),
+        )
+
+        # ---- writeback: each shard keeps the slot rows it owns ----
+        own = (tracked >= my * n_loc) & (tracked < (my + 1) * n_loc)
+        local_target = jnp.where(own, tracked - my * n_loc, DUMMY_LOCAL)
+        used_ext = used_ext.at[local_target].set(
+            jnp.where(own[:, None], U, used_ext[local_target])
+        )
+
+        n_consumed = jnp.sum(consumed_blk.astype(jnp.int32))
+        chosen_blk = jnp.where(consumed_blk, chosen_blk, -1)
+        return used_ext, chosen_blk, n_consumed
+
+    def cond(state):
+        _, cursor, _ = state
+        return cursor < T
+
+    def body(state):
+        used_ext, cursor, chosen_out = state
+        used_ext, chosen_blk, n_consumed = run_block(used_ext, cursor)
+        chosen_out = jax.lax.dynamic_update_slice(
+            chosen_out,
+            jnp.where(
+                jnp.arange(B) < n_consumed,
+                chosen_blk,
+                jax.lax.dynamic_slice(chosen_out, (cursor,), (B,)),
+            ),
+            (cursor,),
+        )
+        cursor = cursor + n_consumed
+
+        def resolve(args):
+            used_ext, cursor, chosen_out = args
+            idx = jnp.minimum(cursor, T - 1)
+            used_ext, chosen1 = full_step(
+                used_ext,
+                task_resreq[idx],
+                task_feas_class[idx],
+                active[idx],
+            )
+            chosen_out = chosen_out.at[idx].set(chosen1)
+            return used_ext, cursor + 1, chosen_out
+
+        state = (used_ext, cursor, chosen_out)
+        return jax.lax.cond(n_consumed < B, resolve, lambda a: a, state)
+
+    init = (
+        used_ext0,
+        jnp.int32(0),
+        jnp.full((T,), -1, dtype=jnp.int32),
     )
-    assignment = jnp.where(committed, chosen, -1)
-    return assignment
+    _, _, chosen = jax.lax.while_loop(cond, body, init)
+    job_assigned = jnp.zeros_like(job_min_available).at[task_job].add(
+        (chosen >= 0).astype(job_min_available.dtype)
+    )
+    return chosen, job_assigned
 
 
 def make_sharded_session(
-    mesh: Mesh, weights: ScoreWeights = DEFAULT_WEIGHTS, gang_rounds: int = 3
+    mesh: Mesh,
+    weights: ScoreWeights = DEFAULT_WEIGHTS,
+    block_size: int = 64,
+    top_k: int = 8,
 ):
-    """Build the jitted node-sharded session program for ``mesh``.
-
-    Node-axis arrays are sharded over the mesh's AXIS dimension; task,
-    class and job arrays are replicated.  Returns fn(arrays…) →
-    assignment[T].
-    """
+    """Build the jitted node-sharded blocked pass for ``mesh``.  Node-axis
+    arrays are sharded over AXIS; task/class/job arrays are replicated.
+    Returns fn(arrays…) → (chosen global node ids, job_assigned)."""
     node_spec2 = P(AXIS, None)
     node_spec1 = P(AXIS)
     rep2 = P(None, None)
     rep1 = P(None)
 
-    body = functools.partial(_sharded_kernel, weights=weights, gang_rounds=gang_rounds)
+    body = functools.partial(
+        _sharded_blocked_kernel,
+        weights=weights,
+        block_size=block_size,
+        top_k=top_k,
+    )
 
     sharded = jax.shard_map(
         body,
@@ -157,14 +290,43 @@ def make_sharded_session(
             node_spec1,  # node_task_count
             node_spec1,  # node_max_tasks
             rep1,  # job_min_available
-            rep1,  # job_ready_count
             rep1,  # tolerance
-            rep1,  # task_valid
+            rep1,  # active
         ),
-        out_specs=rep1,
+        out_specs=(rep1, rep1),
         check_vma=False,
     )
     return jax.jit(sharded)
+
+
+def _shard_nodes_with_dummies(snap: PackedSnapshot, n_dev: int):
+    """Rearrange node arrays into n_dev chunks of n_loc real rows + one
+    trailing infeasible dummy row each → global width n_dev*(n_loc+1).
+    Global id mapping: (shard s, local i) ↔ true node s*n_loc + i."""
+    N_pad = snap.node_idle.shape[0]
+    if N_pad % n_dev:
+        raise ValueError(
+            f"padded node count {N_pad} not divisible by mesh size {n_dev}"
+        )
+    n_loc = N_pad // n_dev
+
+    def rearrange(arr, fill=0):
+        shaped = arr.reshape(n_dev, n_loc, *arr.shape[1:])
+        dummy = np.full((n_dev, 1, *arr.shape[1:]), fill, dtype=arr.dtype)
+        return np.concatenate([shaped, dummy], axis=1).reshape(
+            n_dev * (n_loc + 1), *arr.shape[1:]
+        )
+
+    return {
+        "node_idle": rearrange(snap.node_idle),
+        "node_used": rearrange(snap.node_used),
+        "node_alloc": rearrange(snap.node_alloc),
+        "node_label_bits": rearrange(snap.node_label_bits),
+        "node_taint_bits": rearrange(snap.node_taint_bits),
+        "node_ok": rearrange(snap.node_ok, fill=False),
+        "node_task_count": rearrange(snap.node_task_count),
+        "node_max_tasks": rearrange(snap.node_max_tasks),
+    }, n_loc
 
 
 def run_packed_sharded(
@@ -172,40 +334,59 @@ def run_packed_sharded(
     mesh: Mesh,
     weights: ScoreWeights = DEFAULT_WEIGHTS,
     gang_rounds: int = 3,
+    block_size: int = 64,
+    top_k: int = 8,
 ) -> np.ndarray:
-    """Host wrapper: PackedSnapshot → assignment[T] on a device mesh."""
+    """Host wrapper: PackedSnapshot → assignment[T] on a device mesh,
+    with the adaptive gang fixpoint (same protocol as run_packed_blocked)
+    around the sharded blocked pass."""
     n_dev = mesh.devices.size
-    N_pad = snap.node_idle.shape[0]
-    if N_pad % n_dev:
-        raise ValueError(f"padded node count {N_pad} not divisible by mesh size {n_dev}")
-
     if not f32_lr_exact(snap):
         weights = weights._replace(lr_int_exact=True)
 
     task_feas_class, class_sel, class_tol = _feasibility_classes(snap)
+    node_arrays, n_loc = _shard_nodes_with_dummies(snap, n_dev)
 
-    T = snap.task_resreq.shape[0]
-    task_valid = np.zeros(T, dtype=bool)
-    task_valid[: snap.n_tasks] = True
+    B = block_size
+    T_pad = snap.task_resreq.shape[0]
+    T_blk = T_pad + (-T_pad) % B + B  # headroom so dynamic_slice stays in range
 
-    fn = make_sharded_session(mesh, weights=weights, gang_rounds=gang_rounds)
-    assignment = fn(
-        jnp.asarray(snap.task_resreq),
-        jnp.asarray(snap.task_job),
-        jnp.asarray(task_feas_class),
+    def pad_tasks(arr, fill=0):
+        out = np.full((T_blk, *arr.shape[1:]), fill, dtype=arr.dtype)
+        out[:T_pad] = arr
+        return out
+
+    task_job = pad_tasks(snap.task_job)
+
+    fn = make_sharded_session(
+        mesh, weights=weights, block_size=block_size, top_k=top_k
+    )
+    # Hoist the invariant arrays to device ONCE — only `active` changes
+    # between gang rounds.
+    dev = [
+        jnp.asarray(pad_tasks(snap.task_resreq)),
+        jnp.asarray(task_job),
+        jnp.asarray(pad_tasks(task_feas_class)),
         jnp.asarray(class_sel),
         jnp.asarray(class_tol),
-        jnp.asarray(snap.node_idle),
-        jnp.asarray(snap.node_used),
-        jnp.asarray(snap.node_alloc),
-        jnp.asarray(snap.node_label_bits),
-        jnp.asarray(snap.node_taint_bits),
-        jnp.asarray(snap.node_ok),
-        jnp.asarray(snap.node_task_count),
-        jnp.asarray(snap.node_max_tasks),
+        jnp.asarray(node_arrays["node_idle"]),
+        jnp.asarray(node_arrays["node_used"]),
+        jnp.asarray(node_arrays["node_alloc"]),
+        jnp.asarray(node_arrays["node_label_bits"]),
+        jnp.asarray(node_arrays["node_taint_bits"]),
+        jnp.asarray(node_arrays["node_ok"]),
+        jnp.asarray(node_arrays["node_task_count"]),
+        jnp.asarray(node_arrays["node_max_tasks"]),
         jnp.asarray(snap.job_min_available),
-        jnp.asarray(snap.job_ready_count),
         jnp.asarray(snap.tolerance),
-        jnp.asarray(task_valid),
+    ]
+
+    return gang_fixpoint(
+        lambda active: fn(*dev, active),
+        task_job,
+        snap.job_min_available,
+        snap.job_ready_count,
+        snap.n_tasks,
+        T_blk,
+        gang_rounds,
     )
-    return np.asarray(assignment)[: snap.n_tasks]
