@@ -1,0 +1,149 @@
+"""Serving surface e2e (VERDICT r2 #4): daemons with real HTTP
+healthz/metrics and ConfigMap-lock leader election.
+
+Mirrors the reference's binary behavior: metrics server
+(cmd/scheduler/app/server.go:96-99), healthz (:101), leader election
+with standby takeover (:110-156)."""
+
+from __future__ import annotations
+
+import time
+import urllib.request
+
+import pytest
+
+from volcano_tpu.apis import batch, core, scheduling
+from volcano_tpu.client import APIServer, KubeClient, VolcanoClient
+from volcano_tpu.cmd import AdmissionDaemon, ControllersDaemon, SchedulerDaemon
+from volcano_tpu.metrics import metrics
+from volcano_tpu.serving import LeaderElector
+
+from tests.builders import build_node
+
+
+def _get(port: int, path: str) -> str:
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.read().decode()
+
+
+def _mk_cluster():
+    api = APIServer()
+    kube = KubeClient(api)
+    vc = VolcanoClient(api)
+    for i in range(3):
+        kube.create_node(build_node(f"node-{i}", {"cpu": "8", "memory": "16Gi"}))
+    vc.create_queue(
+        scheduling.Queue(metadata=core.ObjectMeta(name="default", namespace=""))
+    )
+    return api, kube, vc
+
+
+def _submit(vc, name="srv-job", replicas=2):
+    task = batch.TaskSpec(
+        name="worker",
+        replicas=replicas,
+        template=core.PodTemplateSpec(
+            spec=core.PodSpec(
+                containers=[
+                    core.Container(resources={"requests": {"cpu": "1", "memory": "1Gi"}})
+                ]
+            )
+        ),
+    )
+    return vc.create_job(
+        batch.Job(
+            metadata=core.ObjectMeta(name=name, namespace="default"),
+            spec=batch.JobSpec(min_available=replicas, tasks=[task]),
+        )
+    )
+
+
+def _wait(predicate, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestServingSurface:
+    def test_healthz_and_metrics_scrape_over_http(self):
+        """Start the three daemons, schedule a real job, scrape a real
+        counter from the scheduler's /metrics over HTTP."""
+        metrics.registry.reset()
+        api, kube, vc = _mk_cluster()
+        admission = AdmissionDaemon(api).start()
+        controllers = ControllersDaemon(api, period=0.05).start()
+        scheduler = SchedulerDaemon(api, schedule_period=0.05).start()
+        try:
+            for daemon in (admission, controllers, scheduler):
+                assert _get(daemon.serving.port, "/healthz") == "ok"
+
+            _submit(vc)
+            assert _wait(
+                lambda: any(
+                    p.spec.node_name for p in kube.list_pods("default")
+                )
+            ), "job pods never got bound"
+
+            body = _get(scheduler.serving.port, "/metrics")
+            assert "volcano_e2e_scheduling_latency_milliseconds_count" in body
+            count_line = [
+                ln for ln in body.splitlines()
+                if ln.startswith("volcano_e2e_scheduling_latency_milliseconds_count")
+            ][0]
+            assert float(count_line.split()[-1]) > 0
+        finally:
+            scheduler.stop()
+            controllers.stop()
+            admission.stop()
+
+    def test_leader_election_single_winner_and_takeover(self):
+        """Two scheduler daemons, one lock: only the leader schedules;
+        killing the leader (no lease release) hands over after expiry."""
+        api, kube, vc = _mk_cluster()
+        a = SchedulerDaemon(
+            api, schedule_period=0.05, leader_elect=True, identity="sched-a",
+            lease_duration=0.5, retry_period=0.05,
+        ).start()
+        assert _wait(lambda: a.elector.is_leader), "first daemon never led"
+        b = SchedulerDaemon(
+            api, schedule_period=0.05, leader_elect=True, identity="sched-b",
+            lease_duration=0.5, retry_period=0.05,
+        ).start()
+        try:
+            _submit(vc, name="le-job")
+            # give b time to (wrongly) schedule if election were broken
+            time.sleep(0.5)
+            assert a.elector.is_leader and not b.elector.is_leader
+            assert a.cycles > 0 and b.cycles == 0
+
+            # crash the leader: no graceful release → expiry takeover
+            a.stop(crash=True)
+            assert _wait(lambda: b.elector.is_leader, timeout=10), (
+                "standby never took over after leader crash"
+            )
+            before = b.cycles
+            assert _wait(lambda: b.cycles > before), "new leader never scheduled"
+        finally:
+            b.stop()
+
+    def test_elector_cas_prevents_double_leadership(self):
+        """Direct elector race: two candidates, one ConfigMap — the CAS
+        guarantees at most one holds the lease at any moment."""
+        api = APIServer()
+        e1 = LeaderElector(api, "lock", "id-1", lease_duration=0.5, retry_period=0.02).start()
+        e2 = LeaderElector(api, "lock", "id-2", lease_duration=0.5, retry_period=0.02).start()
+        try:
+            assert _wait(lambda: e1.is_leader or e2.is_leader)
+            for _ in range(20):
+                assert not (e1.is_leader and e2.is_leader)
+                time.sleep(0.02)
+            # graceful release hands over quickly
+            leader, standby = (e1, e2) if e1.is_leader else (e2, e1)
+            leader.stop(release=True)
+            assert _wait(lambda: standby.is_leader, timeout=5)
+        finally:
+            e1.stop()
+            e2.stop()

@@ -108,7 +108,12 @@ class APIServer:
             self._notify(kind, ADDED, None, stored.clone())
             return obj
 
-    def update(self, obj):
+    def update(self, obj, expected_rv: Optional[int] = None):
+        """Update; with ``expected_rv`` set, an optimistic-concurrency
+        CAS: succeeds only if the stored resourceVersion still equals it
+        (the k8s semantics the reference's ConfigMap leader lock relies
+        on, cmd/scheduler/app/server.go:110-156).  Admission UPDATE hooks
+        run either way, as they do for real k8s CAS updates."""
         with self._lock:
             kind = obj.kind
             obj = self._run_admission(kind, "UPDATE", obj)
@@ -117,11 +122,23 @@ class APIServer:
             old = bucket.get(key)
             if old is None:
                 raise NotFoundError(f"{kind} {key} not found")
+            if (
+                expected_rv is not None
+                and old.metadata.resource_version != expected_rv
+            ):
+                raise ConflictError(
+                    f"{kind} {key} resourceVersion {old.metadata.resource_version}"
+                    f" != expected {expected_rv}"
+                )
             self._bump(obj)
             stored = obj.clone()
             bucket[key] = stored
             self._notify(kind, MODIFIED, old.clone(), stored.clone())
             return obj
+
+    def compare_and_update(self, obj, expected_rv: int):
+        """CAS alias: ``update`` with a required expected resourceVersion."""
+        return self.update(obj, expected_rv=expected_rv)
 
     def update_status(self, obj):
         """Status subresource write — same store, no admission."""

@@ -1,0 +1,15 @@
+"""Daemon entry points — the cmd/{scheduler,controllers,admission}
+binaries of the reference (cmd/scheduler/main.go:46-68,
+cmd/controllers/main.go, cmd/admission/main.go), rebuilt as daemon
+classes over the in-process API server plus argparse mains.
+
+Each daemon carries the reference binary's serving surface: healthz +
+/metrics HTTP (ServingServer) and optional ConfigMap-lock leader
+election (LeaderElector) gating its work loop.
+"""
+
+from volcano_tpu.cmd.admission import AdmissionDaemon
+from volcano_tpu.cmd.controllers import ControllersDaemon
+from volcano_tpu.cmd.scheduler import SchedulerDaemon
+
+__all__ = ["AdmissionDaemon", "ControllersDaemon", "SchedulerDaemon"]

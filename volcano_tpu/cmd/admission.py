@@ -1,0 +1,67 @@
+"""vtpu-admission — the admission-webhook daemon.
+
+Reference: cmd/admission/app/server.go:37-99 — registers the webhook
+configurations (validate/mutate jobs, validate pods) and serves; here
+registration targets the in-process API server's admission chain and
+the serving surface carries healthz + metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from volcano_tpu.admission import register_webhooks
+from volcano_tpu.client import APIServer
+from volcano_tpu.cmd.scheduler import add_common_args
+from volcano_tpu.serving import ServingServer
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class AdmissionDaemon:
+    """The admission binary: webhook registration + serving surface."""
+
+    def __init__(
+        self,
+        api: APIServer,
+        gate_pods: bool = False,
+        listen_host: str = "127.0.0.1",
+        listen_port: int = 0,
+    ):
+        self.api = api
+        register_webhooks(api, gate_pods=gate_pods)
+        self.serving = ServingServer(host=listen_host, port=listen_port)
+
+    def start(self) -> "AdmissionDaemon":
+        self.serving.start()
+        log.info("admission daemon serving on :%d", self.serving.port)
+        return self
+
+    def stop(self) -> None:
+        self.serving.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="vtpu-admission")
+    parser.add_argument("--gate-pods", action="store_true")
+    add_common_args(parser)
+    args = parser.parse_args(argv)
+    daemon = AdmissionDaemon(
+        APIServer(),
+        gate_pods=args.gate_pods,
+        listen_host=args.listen_host,
+        listen_port=args.listen_port,
+    )
+    daemon.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

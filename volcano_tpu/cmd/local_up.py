@@ -1,0 +1,111 @@
+"""vtpu-local-up — bring up the whole control plane in one process.
+
+The standalone equivalent of hack/local-up-volcano.sh: one in-process
+API server, admission + controllers + scheduler daemons, a synthetic
+node pool, and a default queue — then an interactive prompt serving
+``vtctl`` commands against the live cluster (or ``--demo`` which
+submits a gang job and waits for it to run, then exits).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from volcano_tpu.apis import core, scheduling
+from volcano_tpu.client import APIServer, KubeClient, VolcanoClient
+from volcano_tpu.cmd import AdmissionDaemon, ControllersDaemon, SchedulerDaemon
+
+
+def _build_node(name: str, cpu: str, mem: str):
+    alloc = {"cpu": cpu, "memory": mem, "pods": "110"}
+    return core.Node(
+        metadata=core.ObjectMeta(name=name, namespace=""),
+        spec=core.NodeSpec(),
+        status=core.NodeStatus(allocatable=dict(alloc), capacity=dict(alloc)),
+    )
+
+
+def local_up(nodes: int = 3, node_cpu: str = "8", node_mem: str = "16Gi",
+             gate_pods: bool = False):
+    """Start the full control plane; returns (api, [daemons])."""
+    api = APIServer()
+    admission = AdmissionDaemon(api, gate_pods=gate_pods).start()
+    kube = KubeClient(api)
+    vc = VolcanoClient(api)
+    for i in range(nodes):
+        kube.create_node(_build_node(f"node-{i}", node_cpu, node_mem))
+    vc.create_queue(
+        scheduling.Queue(metadata=core.ObjectMeta(name="default", namespace=""))
+    )
+    controllers = ControllersDaemon(api, period=0.1).start()
+    scheduler = SchedulerDaemon(api, schedule_period=0.2).start()
+    return api, [admission, controllers, scheduler]
+
+
+def _demo(api: APIServer) -> int:
+    from volcano_tpu.apis import batch
+
+    vc = VolcanoClient(api)
+    kube = KubeClient(api)
+    task = batch.TaskSpec(
+        name="worker",
+        replicas=3,
+        template=core.PodTemplateSpec(
+            spec=core.PodSpec(
+                containers=[
+                    core.Container(resources={"requests": {"cpu": "1", "memory": "1Gi"}})
+                ]
+            )
+        ),
+    )
+    vc.create_job(
+        batch.Job(
+            metadata=core.ObjectMeta(name="demo", namespace="default"),
+            spec=batch.JobSpec(min_available=3, tasks=[task]),
+        )
+    )
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        pods = kube.list_pods("default")
+        if pods and all(p.spec.node_name for p in pods):
+            print("demo job bound:", [(p.metadata.name, p.spec.node_name) for p in pods])
+            return 0
+        time.sleep(0.2)
+    print("demo job did not bind within 30s", file=sys.stderr)
+    return 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="vtpu-local-up")
+    parser.add_argument("--nodes", type=int, default=3)
+    parser.add_argument("--node-cpu", default="8")
+    parser.add_argument("--node-mem", default="16Gi")
+    parser.add_argument("--demo", action="store_true",
+                        help="submit a gang job, wait for it to run, exit")
+    args = parser.parse_args(argv)
+
+    api, daemons = local_up(args.nodes, args.node_cpu, args.node_mem)
+    print(
+        "control plane up: admission/controllers/scheduler serving on ports",
+        [d.serving.port for d in daemons],
+    )
+    try:
+        if args.demo:
+            return _demo(api)
+        from volcano_tpu.cli.vtctl import main as vtctl_main
+
+        print("interactive vtctl — e.g. `job list` (ctrl-d to exit)")
+        for line in sys.stdin:
+            argv_line = line.split()
+            if argv_line:
+                vtctl_main(argv_line, api=api)
+        return 0
+    finally:
+        for d in daemons:
+            d.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -303,16 +303,26 @@ def schedule_pass_blocked(
     return chosen, job_assigned
 
 
-def prepare_blocked_arrays(snap: PackedSnapshot, block_size: int = 64):
-    """Host-side array prep: dummy node row + task padding to block size."""
+def task_block_padding(snap: PackedSnapshot, block_size: int):
+    """(T_blk, pad_tasks) — the task padding both blocked wrappers use.
+    T_blk = T_pad rounded to the block size PLUS one block of headroom,
+    so a dynamic_slice after an unaligned stop-resolve never clamps into
+    live tasks.  The single copy — ops/sharded.py imports this too."""
     B = block_size
     T_pad = snap.task_resreq.shape[0]
-    T_blk = T_pad + (-T_pad) % B + B  # headroom so dynamic_slice stays in range
+    T_blk = T_pad + (-T_pad) % B + B
 
     def pad_tasks(arr, fill=0):
         out = np.full((T_blk, *arr.shape[1:]), fill, dtype=arr.dtype)
         out[:T_pad] = arr
         return out
+
+    return T_blk, pad_tasks
+
+
+def prepare_blocked_arrays(snap: PackedSnapshot, block_size: int = 64):
+    """Host-side array prep: dummy node row + task padding to block size."""
+    T_blk, pad_tasks = task_block_padding(snap, block_size)
 
     task_feas_class, class_sel, class_tol = _feasibility_classes(snap)
 

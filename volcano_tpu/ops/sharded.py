@@ -44,7 +44,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from volcano_tpu.ops.blocked import _block_scores, gang_fixpoint, make_inner_step
+from volcano_tpu.ops.blocked import (
+    INT_BIG,
+    _block_scores,
+    gang_fixpoint,
+    make_inner_step,
+    task_block_padding,
+)
 from volcano_tpu.ops.kernels import (
     DEFAULT_WEIGHTS,
     ScoreWeights,
@@ -54,7 +60,6 @@ from volcano_tpu.ops.kernels import (
 from volcano_tpu.ops.packing import PackedSnapshot
 
 AXIS = "nodes"
-INT_BIG = np.int32(2**31 - 1)
 
 
 def _sharded_blocked_kernel(
@@ -347,14 +352,7 @@ def run_packed_sharded(
     task_feas_class, class_sel, class_tol = _feasibility_classes(snap)
     node_arrays, n_loc = _shard_nodes_with_dummies(snap, n_dev)
 
-    B = block_size
-    T_pad = snap.task_resreq.shape[0]
-    T_blk = T_pad + (-T_pad) % B + B  # headroom so dynamic_slice stays in range
-
-    def pad_tasks(arr, fill=0):
-        out = np.full((T_blk, *arr.shape[1:]), fill, dtype=arr.dtype)
-        out[:T_pad] = arr
-        return out
+    T_blk, pad_tasks = task_block_padding(snap, block_size)
 
     task_job = pad_tasks(snap.task_job)
 

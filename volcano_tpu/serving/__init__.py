@@ -1,0 +1,9 @@
+"""Serving surface: HTTP healthz + Prometheus /metrics exposition and
+ConfigMap-lock leader election — the standalone equivalents of
+cmd/scheduler/app/server.go:96-156 and pkg/apis/helpers/helpers.go:195.
+"""
+
+from volcano_tpu.serving.http import ServingServer
+from volcano_tpu.serving.leader import LeaderElector
+
+__all__ = ["ServingServer", "LeaderElector"]

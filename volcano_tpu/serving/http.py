@@ -1,0 +1,80 @@
+"""HTTP serving: /healthz + /metrics.
+
+Reference: the scheduler binary starts a Prometheus handler on
+--listen-address (cmd/scheduler/app/server.go:96-99) and a healthz
+endpoint (pkg/apis/helpers/helpers.go:195 StartHealthz); controllers and
+admission do the same.  Here one small threaded server carries both:
+
+  GET /healthz  → 200 "ok"      (liveness)
+  GET /metrics  → Prometheus text exposition of metrics.registry
+
+No third-party client library — metrics._Registry.render() already
+emits the text format.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from volcano_tpu.metrics import metrics
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "volcano-tpu"
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path == "/healthz":
+            body = b"ok"
+            ctype = "text/plain"
+        elif self.path == "/metrics":
+            body = self.server.registry.render().encode()
+            ctype = "text/plain; version=0.0.4"
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # silence per-request stderr noise
+        pass
+
+
+class ServingServer:
+    """Threaded healthz+metrics server.  ``port=0`` binds an ephemeral
+    port (read it back from ``.port`` after start)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, registry=None):
+        self._host = host
+        self._port = port
+        self._registry = registry if registry is not None else metrics.registry
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None, "server not started"
+        return self._httpd.server_address[1]
+
+    def start(self) -> "ServingServer":
+        self._httpd = ThreadingHTTPServer((self._host, self._port), _Handler)
+        self._httpd.registry = self._registry
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="vtpu-serving", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
