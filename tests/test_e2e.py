@@ -290,3 +290,195 @@ class TestE2EPreemption:
         # waits pending (the node is full again).
         assert low.status.running == 1
         assert low.status.pending == 1
+
+
+class TestE2EEventAuditTrail:
+    """VERDICT r2 #5: Events recorded through the bus on bind/evict/
+    unschedulable (cache.go:600-610, 832-867) and surfaced in
+    `vtctl job view`."""
+
+    def test_bind_records_scheduled_events(self):
+        cluster = Cluster()
+        submit(cluster)
+        cluster.tick()
+        events = cluster.api.list("Event", "default")
+        scheduled = [e for e in events if e.reason == "Scheduled"]
+        assert len(scheduled) == 3
+        assert all("Successfully assigned" in e.message for e in scheduled)
+        assert {e.involved_object["name"] for e in scheduled} == {
+            f"e2e-job-worker-{i}" for i in range(3)
+        }
+
+    def test_gang_discard_records_unschedulable_events(self):
+        cluster = Cluster(nodes=1, node_cpu="2")
+        submit(cluster, replicas=4, min_available=4)
+        cluster.tick()
+        events = cluster.api.list("Event", "default")
+        unsched = [e for e in events if e.reason == "Unschedulable"]
+        assert unsched, "gang discard must leave an Unschedulable audit trail"
+        assert all(e.type == "Warning" for e in unsched)
+
+    def test_preemption_records_evict_events(self, tmp_path):
+        conf = tmp_path / "scheduler.yaml"
+        conf.write_text(PREEMPT_CONF)
+        cluster = Cluster(nodes=1, node_cpu="2", node_mem="4Gi")
+        cluster.scheduler.scheduler_conf_path = str(conf)
+        cluster.kube.create_priority_class(
+            core.PriorityClass(metadata=core.ObjectMeta(name="high"), value=1000)
+        )
+        submit(cluster, name="low-job", replicas=2, min_available=1)
+        cluster.tick()
+        submit(cluster, name="high-job", replicas=1, min_available=1,
+               priority_class_name="high")
+        cluster.tick(rounds=6)
+
+        events = cluster.api.list("Event", "default")
+        evicts = [e for e in events if e.reason == "Evict"]
+        assert evicts, "preemption must leave an Evict audit trail"
+        assert any("preempt" in e.message for e in evicts)
+        assert all(e.involved_object["name"].startswith("low-job-") for e in evicts)
+
+    def test_vtctl_job_view_shows_events(self):
+        import io
+
+        cluster = Cluster()
+        submit(cluster)
+        cluster.tick()
+        out = io.StringIO()
+        rc = vtctl(["job", "view", "-N", "e2e-job", "-n", "default"],
+                   api=cluster.api, out=out)
+        assert rc == 0
+        text = out.getvalue()
+        assert "Events:" in text
+        assert "Scheduled" in text and "Successfully assigned" in text
+
+
+class TestE2EVolumeBinding:
+    """VERDICT r2 #7: real allocate/bind volumes against PVC objects on
+    the bus, gating bind (cache.go:243-258, 557-615)."""
+
+    def test_pod_waits_on_unbound_pvc_then_binds(self):
+        """A job whose PVC is Pending with no storage class (static
+        binding, nothing to bind to) must NOT bind; once an admin binds
+        the PVC, the job schedules."""
+        cluster = Cluster()
+        cluster.kube.create_pvc(
+            core.PersistentVolumeClaim(
+                metadata=core.ObjectMeta(name="data", namespace="default"),
+                spec={},  # no storageClassName → immediate/static binding
+                status={"phase": "Pending"},
+            )
+        )
+        submit(
+            cluster,
+            name="vol-job",
+            volumes=[batch.VolumeSpec(mount_path="/data", volume_claim_name="data")],
+        )
+        cluster.tick()
+        pods = cluster.kube.list_pods("default")
+        assert pods and all(not p.spec.node_name for p in pods), (
+            "pods must wait on the unbound PVC"
+        )
+        events = cluster.api.list("Event", "default")
+        assert any(
+            "PersistentVolumeClaims" in e.message for e in events
+        ), "unschedulable reason must mention the unbound PVC"
+
+        # admin binds the PVC (static PV provisioned out of band)
+        pvc = cluster.kube.get_pvc("default", "data")
+        pvc.status["phase"] = "Bound"
+        cluster.kube.update_pvc(pvc)
+        cluster.tick()
+        pods = cluster.kube.list_pods("default")
+        assert all(p.spec.node_name for p in pods)
+
+    def test_dynamic_provisioning_binds_and_stamps_pvc(self):
+        """A PVC with a storage class is provisionable: the scheduler
+        binds the pods and bind_volumes stamps the PVC Bound with the
+        selected node."""
+        cluster = Cluster()
+        cluster.kube.create_pvc(
+            core.PersistentVolumeClaim(
+                metadata=core.ObjectMeta(name="dyn", namespace="default"),
+                spec={"storageClassName": "standard"},
+                status={"phase": "Pending"},
+            )
+        )
+        submit(
+            cluster,
+            name="dyn-job",
+            volumes=[batch.VolumeSpec(mount_path="/data", volume_claim_name="dyn")],
+        )
+        cluster.tick()
+        pods = cluster.kube.list_pods("default")
+        assert all(p.spec.node_name for p in pods)
+        pvc = cluster.kube.get_pvc("default", "dyn")
+        assert pvc.status["phase"] == "Bound"
+        assert pvc.spec["volumeName"] == "pv-dyn"
+        assert pvc.metadata.annotations["volume.kubernetes.io/selected-node"]
+
+    def test_missing_pvc_gates_at_controller(self):
+        """A job naming a PVC that doesn't exist is held by the job
+        controller itself (createJobIOIfNotExist validation) — no pods
+        are created until the claim appears."""
+        cluster = Cluster()
+        submit(
+            cluster,
+            name="miss-job",
+            volumes=[batch.VolumeSpec(mount_path="/d", volume_claim_name="nope")],
+        )
+        cluster.tick()
+        assert not cluster.kube.list_pods("default")
+
+        # scheduler-level gate for an already-created pod whose PVC
+        # vanishes: create the claim, let pods appear, then delete it
+        cluster.kube.create_pvc(
+            core.PersistentVolumeClaim(
+                metadata=core.ObjectMeta(name="nope", namespace="default"),
+                spec={"storageClassName": "standard"},
+                status={"phase": "Pending"},
+            )
+        )
+        # re-trigger the sync (the reference requeues with backoff; here
+        # a spec touch raises OutOfSync deterministically)
+        job = cluster.vc.get_job("default", "miss-job")
+        job.spec.max_retry = (job.spec.max_retry or 3) + 1
+        cluster.vc.update_job(job)
+        cluster.job_controller.drain()
+        assert cluster.kube.list_pods("default"), "pods should exist now"
+        cluster.api.delete("PersistentVolumeClaim", "default", "nope")
+        cluster.tick()
+        pods = cluster.kube.list_pods("default")
+        assert pods and all(not p.spec.node_name for p in pods), (
+            "pods referencing a vanished PVC must not bind"
+        )
+
+
+class TestE2EEventAggregation:
+    def test_repeated_unschedulable_stays_bounded(self):
+        """Cycling a stuck job must not mint new Event objects per cycle
+        (the job updater's status-diff gate plus the recorder's
+        correlator keep the store bounded)."""
+        cluster = Cluster(nodes=1, node_cpu="2")
+        submit(cluster, replicas=4, min_available=4)
+        cluster.tick(rounds=8)
+        events = [
+            e for e in cluster.api.list("Event", "default")
+            if e.reason == "Unschedulable"
+        ]
+        names = [e.involved_object["name"] for e in events]
+        assert names and len(names) == len(set(names)), "one Event object per pod"
+
+    def test_recorder_aggregates_repeats(self):
+        """k8s correlator behavior: the same (object, reason, message)
+        bumps count instead of creating a new Event."""
+        cluster = Cluster()
+        client = SchedulerClient(cluster.api)
+        for _ in range(5):
+            client.record_event(
+                "default", {"kind": "Pod", "name": "p1"}, "Warning",
+                "Unschedulable", "0/1 nodes available",
+            )
+        events = cluster.api.list("Event", "default")
+        assert len(events) == 1
+        assert events[0].count == 5

@@ -18,6 +18,9 @@ class ClusterInfo:
         self.nodes: Dict[str, NodeInfo] = {}
         self.queues: Dict[str, QueueInfo] = {}
         self.namespace_info: Dict[str, NamespaceInfo] = {}
+        #: PVCs keyed "ns/name" — consumed by the volume-binding
+        #: predicate (the vendored VolumeBindingChecker analogue).
+        self.pvcs: Dict[str, object] = {}
 
     def __repr__(self) -> str:
         return (

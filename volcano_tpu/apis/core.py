@@ -260,9 +260,12 @@ class NetworkPolicy(K8sObject):
 
 @dataclass
 class Event(K8sObject):
-    """Kubernetes Event — the user-facing audit trail."""
+    """Kubernetes Event — the user-facing audit trail.  ``count``
+    aggregates repeats of the same (object, reason, message), as the
+    k8s event recorder's correlator does."""
 
     involved_object: Dict[str, str] = field(default_factory=dict)
     type: str = "Normal"
     reason: str = ""
     message: str = ""
+    count: int = 1

@@ -90,6 +90,8 @@ class SchedulerCache(Cache):
         self.queues: Dict[str, QueueInfo] = {}
         self.priority_classes: Dict[str, core.PriorityClass] = {}
         self.namespace_collections: Dict[str, NamespaceCollection] = {}
+        #: PVCs keyed "ns/name" (pvcInformer, cache.go:415-421)
+        self.pvcs: Dict[str, core.PersistentVolumeClaim] = {}
 
         self.client = client
         self.binder = binder or (DefaultBinder(client) if client else None)
@@ -259,6 +261,19 @@ class SchedulerCache(Cache):
             if pc.global_default:
                 self.default_priority = 0
 
+    # ---- PVC handlers (pvcInformer wiring, cache.go:415-421) ----
+
+    def add_pvc(self, pvc: core.PersistentVolumeClaim) -> None:
+        with self._mutex:
+            self.pvcs[f"{pvc.metadata.namespace}/{pvc.metadata.name}"] = pvc
+
+    def update_pvc(self, old, new: core.PersistentVolumeClaim) -> None:
+        self.add_pvc(new)
+
+    def delete_pvc(self, pvc: core.PersistentVolumeClaim) -> None:
+        with self._mutex:
+            self.pvcs.pop(f"{pvc.metadata.namespace}/{pvc.metadata.name}", None)
+
     # ---- event handlers: resource quotas (event_handlers.go:961-1036) ----
 
     def add_resource_quota(self, namespace: str, quota_name: str, weight: Optional[int]) -> None:
@@ -287,6 +302,9 @@ class SchedulerCache(Cache):
 
             for queue in self.queues.values():
                 snapshot.queues[queue.uid] = queue.clone()
+
+            for key, pvc in self.pvcs.items():
+                snapshot.pvcs[key] = pvc.clone()
 
             for name, coll in self.namespace_collections.items():
                 snapshot.namespace_info[name] = coll.snapshot()
@@ -339,9 +357,36 @@ class SchedulerCache(Cache):
                     self.binder.bind(task, hostname)
             except Exception as e:  # noqa: BLE001
                 log.error("bind of %s/%s failed: %s", task.namespace, task.name, e)
+                self._record_event(
+                    task, "Warning", "FailedScheduling",
+                    f"failed to bind to {hostname}: {e}",
+                )
                 self.resync_task(task)
+            else:
+                # cache.go:600-610 — the Scheduled audit event
+                self._record_event(
+                    task, "Normal", "Scheduled",
+                    f"Successfully assigned {task.namespace}/{task.name}"
+                    f" to {hostname}",
+                )
 
         self._run_effect(effect)
+
+    def _record_event(self, task: TaskInfo, type_: str, reason: str, message: str) -> None:
+        """Record a pod-scoped Event through the bus (the user-facing
+        audit trail, cache.go:832-867, 600-610); best-effort."""
+        if self.client is None or not hasattr(self.client, "record_event"):
+            return
+        try:
+            self.client.record_event(
+                task.namespace,
+                {"kind": "Pod", "namespace": task.namespace, "name": task.name},
+                type_,
+                reason,
+                message,
+            )
+        except Exception as e:  # noqa: BLE001 — events must never fail ops
+            log.error("record event failed: %s", e)
 
     def evict(self, task_info: TaskInfo, reason: str) -> None:
         """cache.go:498-554."""
@@ -362,8 +407,73 @@ class SchedulerCache(Cache):
             except Exception as e:  # noqa: BLE001
                 log.error("evict of %s/%s failed: %s", task.namespace, task.name, e)
                 self.resync_task(task)
+            else:
+                # cache.go:528 — the Evict audit event (reason carries the
+                # action: "preempt" / "reclaim")
+                self._record_event(
+                    task, "Normal", "Evict",
+                    f"Evicted {task.namespace}/{task.name}: {reason}",
+                )
 
         self._run_effect(effect)
+
+    # ---- volume binding (cache.go:243-258, 617-623) ----
+
+    @staticmethod
+    def task_claim_names(task: TaskInfo) -> List[str]:
+        """PVC claim names referenced by the task's pod."""
+        if task.pod is None:
+            return []
+        claims = []
+        for vol in task.pod.spec.volumes:
+            ref = vol.source.get("persistentVolumeClaim")
+            if ref and ref.get("claimName"):
+                claims.append(ref["claimName"])
+        return claims
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        """AssumePodVolumes analogue: record whether every referenced PVC
+        is already Bound (task.volume_ready), so bind_volumes knows
+        whether there is provisioning left to do (cache.go:243-249)."""
+        with self._mutex:
+            all_bound = True
+            for claim in self.task_claim_names(task):
+                pvc = self.pvcs.get(f"{task.namespace}/{claim}")
+                if pvc is None or pvc.status.get("phase") != "Bound":
+                    all_bound = False
+            task.volume_ready = all_bound
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        """BindPodVolumes analogue (cache.go:251-258): dynamically
+        provision still-pending PVCs that carry a storage class — write
+        the selected node, a volume name, and phase Bound through the
+        client.  Raises on a PVC that cannot be bound (no storage class,
+        nothing provisionable) — the commit path converts that into an
+        unbind + resync, exactly like an apiserver bind failure."""
+        if task.volume_ready:
+            return
+        for claim in self.task_claim_names(task):
+            key = f"{task.namespace}/{claim}"
+            with self._mutex:
+                pvc = self.pvcs.get(key)
+            if pvc is None:
+                raise KeyError(f"persistentvolumeclaim {key} not found")
+            if pvc.status.get("phase") == "Bound":
+                continue
+            if not pvc.spec.get("storageClassName"):
+                raise RuntimeError(
+                    f"pod has unbound immediate PersistentVolumeClaims: {key}"
+                )
+            pvc = pvc.clone()
+            pvc.metadata.annotations["volume.kubernetes.io/selected-node"] = (
+                task.node_name
+            )
+            pvc.spec["volumeName"] = f"pv-{pvc.metadata.name}"
+            pvc.status["phase"] = "Bound"
+            if self.client is not None and hasattr(self.client, "update_pvc"):
+                self.client.update_pvc(pvc)
+            self.add_pvc(pvc)
+        task.volume_ready = True
 
     def resync_task(self, task: TaskInfo) -> None:
         """Requeue for resync from API truth (cache.go:687-709)."""
@@ -398,6 +508,7 @@ class SchedulerCache(Cache):
                 continue
             fit_errors = job.nodes_fit_errors.get(task.uid)
             message = fit_errors.error() if fit_errors is not None else base_message
+            self._record_event(task, "Warning", "Unschedulable", message)
             try:
                 self.status_updater.update_pod_condition(task, "Unschedulable", message)
             except Exception as e:  # noqa: BLE001

@@ -134,6 +134,35 @@ def _job_view(vc: VolcanoClient, args, out) -> int:
     import yaml
 
     print(yaml.safe_dump(job.to_dict(), sort_keys=False), file=out)
+
+    # Events section (kubectl-describe style): the audit trail for the
+    # job's pods and its podgroup (cache.go:600-610, 832-867 recorders).
+    # Pod names follow <job>-<task>-<idx> — match exactly that shape per
+    # task spec (a bare "<job>-" prefix would also swallow events of a
+    # sibling job named "<job>-something").
+    import re
+
+    jn = re.escape(job.metadata.name)
+    patterns = [
+        re.compile(rf"^{jn}-{re.escape(t.name)}-\d+$") for t in job.spec.tasks
+    ]
+    def _belongs(name: str) -> bool:
+        return name == job.metadata.name or any(p.match(name) for p in patterns)
+
+    events = [
+        e
+        for e in vc.api.list("Event", args.namespace)
+        if _belongs(e.involved_object.get("name", ""))
+    ]
+    if events:
+        print("Events:", file=out)
+        print(f"  {'Type':<8} {'Count':<6} {'Reason':<18} {'Object':<32} Message", file=out)
+        for e in sorted(events, key=lambda e: e.metadata.resource_version):
+            obj = f"{e.involved_object.get('kind', '')}/{e.involved_object.get('name', '')}"
+            print(
+                f"  {e.type:<8} {e.count:<6} {e.reason:<18} {obj:<32} {e.message}",
+                file=out,
+            )
     return 0
 
 

@@ -99,6 +99,12 @@ class KubeClient:
     def create_pvc(self, pvc: core.PersistentVolumeClaim) -> core.PersistentVolumeClaim:
         return self.api.create(pvc)
 
+    def update_pvc(self, pvc: core.PersistentVolumeClaim) -> core.PersistentVolumeClaim:
+        return self.api.update(pvc)
+
+    def list_pvcs(self, namespace: Optional[str] = None) -> List[core.PersistentVolumeClaim]:
+        return self.api.list("PersistentVolumeClaim", namespace)
+
     def get_pvc(self, namespace: str, name: str) -> Optional[core.PersistentVolumeClaim]:
         return self.api.get("PersistentVolumeClaim", namespace, name)
 
@@ -107,6 +113,9 @@ class KubeClient:
 
     def create_event(self, event: core.Event) -> core.Event:
         return self.api.create(event)
+
+    def list_events(self, namespace: Optional[str] = None) -> List[core.Event]:
+        return self.api.list("Event", namespace)
 
 
 class VolcanoClient:
@@ -231,11 +240,20 @@ class SchedulerClient:
             elif event == DELETED:
                 cache.delete_priority_class(old)
 
+        def pvcs(event, old, new):
+            if event == ADDED:
+                cache.add_pvc(new)
+            elif event == MODIFIED:
+                cache.update_pvc(old, new)
+            elif event == DELETED:
+                cache.delete_pvc(old)
+
         self.api.watch("Pod", pods)
         self.api.watch("Node", nodes)
         self.api.watch("PodGroup", pod_groups)
         self.api.watch("Queue", queues)
         self.api.watch("PriorityClass", priority_classes)
+        self.api.watch("PersistentVolumeClaim", pvcs)
 
     # side effects used by SchedulerCache
     def bind_pod(self, namespace: str, name: str, hostname: str) -> None:
@@ -255,3 +273,40 @@ class SchedulerClient:
             return self.vc.update_pod_group(pg)
         except NotFoundError:
             return None
+
+    def update_pvc(self, pvc: core.PersistentVolumeClaim) -> core.PersistentVolumeClaim:
+        return self.kube.update_pvc(pvc)
+
+    def record_event(
+        self,
+        namespace: str,
+        involved: dict,
+        type_: str,
+        reason: str,
+        message: str,
+    ) -> core.Event:
+        """Event recorder (the scheduler's user-facing audit trail —
+        cache.go:304-306 eventBroadcaster + :600-610, 832-867 call
+        sites).  Repeats of the same (object, reason, message) aggregate
+        into one Event with a bumped ``count`` — the k8s correlator
+        behavior — so a stuck pending job cannot grow the store
+        unboundedly across scheduling cycles."""
+        import hashlib
+
+        digest = hashlib.sha1(
+            f"{involved.get('kind')}/{involved.get('name')}|{reason}|{message}".encode()
+        ).hexdigest()[:10]
+        name = f"{involved.get('name', 'obj')}.{digest}"
+        existing = self.api.get("Event", namespace, name)
+        if existing is not None:
+            existing.count += 1
+            return self.api.update(existing)
+        return self.kube.create_event(
+            core.Event(
+                metadata=core.ObjectMeta(name=name, namespace=namespace),
+                involved_object=involved,
+                type=type_,
+                reason=reason,
+                message=message,
+            )
+        )

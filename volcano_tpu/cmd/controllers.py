@@ -8,12 +8,9 @@ plus the healthz/metrics serving surface.
 from __future__ import annotations
 
 import argparse
-import threading
-import time
-import uuid
-from typing import Optional
 
 from volcano_tpu.client import APIServer
+from volcano_tpu.cmd.daemon import BaseDaemon, serve_forever
 from volcano_tpu.cmd.scheduler import add_common_args
 from volcano_tpu.controllers import (
     GarbageCollector,
@@ -21,48 +18,20 @@ from volcano_tpu.controllers import (
     PodGroupController,
     QueueController,
 )
-from volcano_tpu.serving import LeaderElector, ServingServer
-from volcano_tpu.utils.logging import get_logger
-
-log = get_logger(__name__)
-
-LOCK_NAME = "vtpu-controllers"
 
 
-class ControllersDaemon:
+class ControllersDaemon(BaseDaemon):
     """The controller-manager binary: all controllers on one drain loop."""
 
-    def __init__(
-        self,
-        api: APIServer,
-        period: float = 0.2,
-        listen_host: str = "127.0.0.1",
-        listen_port: int = 0,
-        leader_elect: bool = False,
-        identity: Optional[str] = None,
-        lease_duration: float = 2.0,
-        retry_period: float = 0.2,
-    ):
-        self.api = api
-        self.period = period
-        self.identity = identity or f"vtpu-controllers-{uuid.uuid4().hex[:8]}"
+    LOCK_NAME = "vtpu-controllers"
+    NAME = "vtpu-controllers"
+
+    def __init__(self, api: APIServer, period: float = 0.2, **daemon_kw):
+        super().__init__(api, period=period, **daemon_kw)
         self.job_controller = JobController(api)
         self.queue_controller = QueueController(api)
         self.podgroup_controller = PodGroupController(api)
         self.gc = GarbageCollector(api)
-        self.serving = ServingServer(host=listen_host, port=listen_port)
-        self.elector: Optional[LeaderElector] = None
-        if leader_elect:
-            self.elector = LeaderElector(
-                api,
-                LOCK_NAME,
-                self.identity,
-                lease_duration=lease_duration,
-                retry_period=retry_period,
-            )
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self.cycles = 0
 
     def drain(self) -> None:
         """One pass over every controller's work queue."""
@@ -71,33 +40,8 @@ class ControllersDaemon:
         self.queue_controller.drain()
         self.gc.process_expired()
 
-    def _loop(self) -> None:
-        while not self._stop.is_set():
-            if self.elector is None or self.elector.is_leader:
-                self.drain()
-                self.cycles += 1
-            self._stop.wait(self.period)
-
-    def start(self) -> "ControllersDaemon":
-        self.serving.start()
-        if self.elector is not None:
-            self.elector.start()
-        self._thread = threading.Thread(
-            target=self._loop, name=f"controllers-{self.identity}", daemon=True
-        )
-        self._thread.start()
-        log.info(
-            "controllers daemon %s serving on :%d", self.identity, self.serving.port
-        )
-        return self
-
-    def stop(self, crash: bool = False) -> None:
-        self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=10)
-        if self.elector is not None:
-            self.elector.stop(release=not crash)
-        self.serving.stop()
+    def _work(self) -> None:
+        self.drain()
 
 
 def main(argv=None) -> int:
@@ -105,21 +49,16 @@ def main(argv=None) -> int:
     parser.add_argument("--period", type=float, default=0.2)
     add_common_args(parser)
     args = parser.parse_args(argv)
-    daemon = ControllersDaemon(
-        APIServer(),
-        period=args.period,
-        listen_host=args.listen_host,
-        listen_port=args.listen_port,
-        leader_elect=args.leader_elect,
-        identity=args.leader_elect_id,
+    return serve_forever(
+        ControllersDaemon(
+            APIServer(),
+            period=args.period,
+            listen_host=args.listen_host,
+            listen_port=args.listen_port,
+            leader_elect=args.leader_elect,
+            identity=args.leader_elect_id,
+        )
     )
-    daemon.start()
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        daemon.stop()
-    return 0
 
 
 if __name__ == "__main__":

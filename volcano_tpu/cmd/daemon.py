@@ -1,0 +1,122 @@
+"""Shared daemon scaffolding: serving surface + leader election + the
+guarded work loop.  SchedulerDaemon and ControllersDaemon differ only in
+their work body and construction; everything else (loop, crash-stop
+semantics, liveness) lives here once.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Optional
+
+from volcano_tpu.client import APIServer
+from volcano_tpu.serving import LeaderElector, ServingServer
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class BaseDaemon:
+    """Work loop + serving + optional leader election.
+
+    Subclasses set ``LOCK_NAME``/``NAME`` and implement ``_work()`` (one
+    cycle).  The loop is exception-guarded — a failing cycle is logged
+    and retried, never silently killing the thread — and ``/healthz``
+    reflects actual loop liveness, not just process liveness."""
+
+    LOCK_NAME = "vtpu-daemon"
+    NAME = "daemon"
+
+    def __init__(
+        self,
+        api: APIServer,
+        period: float = 0.2,
+        listen_host: str = "127.0.0.1",
+        listen_port: int = 0,
+        leader_elect: bool = False,
+        identity: Optional[str] = None,
+        lease_duration: float = 2.0,
+        retry_period: float = 0.2,
+    ):
+        self.api = api
+        self.period = period
+        self.identity = identity or f"{self.NAME}-{uuid.uuid4().hex[:8]}"
+        self.serving = ServingServer(
+            host=listen_host, port=listen_port, health_check=self.healthy
+        )
+        self.elector: Optional[LeaderElector] = None
+        if leader_elect:
+            self.elector = LeaderElector(
+                api,
+                self.LOCK_NAME,
+                self.identity,
+                lease_duration=lease_duration,
+                retry_period=retry_period,
+            )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: cycles this instance actually ran (leadership observability)
+        self.cycles = 0
+        self.last_error: Optional[str] = None
+
+    # ---- subclass API ----
+
+    def _work(self) -> None:
+        raise NotImplementedError
+
+    def _on_start(self) -> None:
+        """Hook before the loop thread starts (e.g. cache informers)."""
+
+    # ---- loop ----
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if self.elector is None or self.elector.is_leader:
+                try:
+                    self._work()
+                    self.cycles += 1
+                    self.last_error = None
+                except Exception as e:  # noqa: BLE001 — keep the loop alive
+                    self.last_error = str(e)
+                    log.error("%s cycle failed: %s", self.NAME, e)
+            self._stop.wait(self.period)
+
+    def healthy(self) -> bool:
+        """Liveness for /healthz: the loop thread must be running (or
+        not yet started)."""
+        return self._thread is None or self._thread.is_alive()
+
+    def start(self):
+        self.serving.start()
+        self._on_start()
+        if self.elector is not None:
+            self.elector.start()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"{self.NAME}-{self.identity}", daemon=True
+        )
+        self._thread.start()
+        log.info("%s %s serving on :%d", self.NAME, self.identity, self.serving.port)
+        return self
+
+    def stop(self, crash: bool = False) -> None:
+        """Stop the daemon.  ``crash=True`` skips the graceful lease
+        release, leaving standbys to take over after expiry."""
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        if self.elector is not None:
+            self.elector.stop(release=not crash)
+        self.serving.stop()
+
+
+def serve_forever(daemon: BaseDaemon) -> int:
+    """Blocking main body shared by the binaries."""
+    daemon.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        daemon.stop()
+    return 0

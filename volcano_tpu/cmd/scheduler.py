@@ -9,24 +9,18 @@ cmd/scheduler/app/options/options.go:44-66.
 from __future__ import annotations
 
 import argparse
-import threading
-import time
-import uuid
-from typing import Optional
 
 from volcano_tpu.cache import SchedulerCache
 from volcano_tpu.client import APIServer, SchedulerClient
+from volcano_tpu.cmd.daemon import BaseDaemon, serve_forever
 from volcano_tpu.scheduler.scheduler import Scheduler
-from volcano_tpu.serving import LeaderElector, ServingServer
-from volcano_tpu.utils.logging import get_logger
-
-log = get_logger(__name__)
-
-LOCK_NAME = "vtpu-scheduler"
 
 
-class SchedulerDaemon:
+class SchedulerDaemon(BaseDaemon):
     """The scheduler binary: cache + session loop + serving surface."""
+
+    LOCK_NAME = "vtpu-scheduler"
+    NAME = "vtpu-scheduler"
 
     def __init__(
         self,
@@ -34,67 +28,21 @@ class SchedulerDaemon:
         scheduler_conf: str = "",
         schedule_period: float = 1.0,
         scheduler_name: str = "volcano-tpu",
-        listen_host: str = "127.0.0.1",
-        listen_port: int = 0,
-        leader_elect: bool = False,
-        identity: Optional[str] = None,
-        lease_duration: float = 2.0,
-        retry_period: float = 0.2,
+        **daemon_kw,
     ):
-        self.api = api
-        self.period = schedule_period
-        self.identity = identity or f"vtpu-scheduler-{uuid.uuid4().hex[:8]}"
+        super().__init__(api, period=schedule_period, **daemon_kw)
         self.cache = SchedulerCache(
             client=SchedulerClient(api), scheduler_name=scheduler_name
         )
         self.scheduler = Scheduler(
             self.cache, scheduler_conf_path=scheduler_conf, period=schedule_period
         )
-        self.serving = ServingServer(host=listen_host, port=listen_port)
-        self.elector: Optional[LeaderElector] = None
-        if leader_elect:
-            self.elector = LeaderElector(
-                api,
-                LOCK_NAME,
-                self.identity,
-                lease_duration=lease_duration,
-                retry_period=retry_period,
-            )
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        #: sessions this instance actually ran (leadership observability)
-        self.cycles = 0
 
-    def _loop(self) -> None:
-        while not self._stop.is_set():
-            if self.elector is None or self.elector.is_leader:
-                self.scheduler.run_once()
-                self.cycles += 1
-            self._stop.wait(self.period)
-
-    def start(self) -> "SchedulerDaemon":
-        self.serving.start()
+    def _on_start(self) -> None:
         self.cache.run()
-        if self.elector is not None:
-            self.elector.start()
-        self._thread = threading.Thread(
-            target=self._loop, name=f"scheduler-{self.identity}", daemon=True
-        )
-        self._thread.start()
-        log.info(
-            "scheduler daemon %s serving on :%d", self.identity, self.serving.port
-        )
-        return self
 
-    def stop(self, crash: bool = False) -> None:
-        """Stop the daemon.  ``crash=True`` skips the graceful lease
-        release, leaving standbys to take over after expiry."""
-        self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=10)
-        if self.elector is not None:
-            self.elector.stop(release=not crash)
-        self.serving.stop()
+    def _work(self) -> None:
+        self.scheduler.run_once()
 
 
 def add_common_args(parser: argparse.ArgumentParser) -> None:
@@ -112,23 +60,18 @@ def main(argv=None) -> int:
     add_common_args(parser)
     args = parser.parse_args(argv)
 
-    daemon = SchedulerDaemon(
-        APIServer(),
-        scheduler_conf=args.scheduler_conf,
-        schedule_period=args.schedule_period,
-        scheduler_name=args.scheduler_name,
-        listen_host=args.listen_host,
-        listen_port=args.listen_port,
-        leader_elect=args.leader_elect,
-        identity=args.leader_elect_id,
+    return serve_forever(
+        SchedulerDaemon(
+            APIServer(),
+            scheduler_conf=args.scheduler_conf,
+            schedule_period=args.schedule_period,
+            scheduler_name=args.scheduler_name,
+            listen_host=args.listen_host,
+            listen_port=args.listen_port,
+            leader_elect=args.leader_elect,
+            identity=args.leader_elect_id,
+        )
     )
-    daemon.start()
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        daemon.stop()
-    return 0
 
 
 if __name__ == "__main__":

@@ -34,6 +34,7 @@ def open_session(
     ssn.nodes = snapshot.nodes
     ssn.queues = snapshot.queues
     ssn.namespace_info = snapshot.namespace_info
+    ssn.pvcs = snapshot.pvcs
 
     # Instantiate plugins listed in tiers (framework.go:37-45).
     for tier in tiers:

@@ -56,6 +56,7 @@ class Session:
         self.nodes: Dict[str, NodeInfo] = {}
         self.queues: Dict[str, QueueInfo] = {}
         self.namespace_info: Dict[str, NamespaceInfo] = {}
+        self.pvcs: Dict[str, object] = {}
 
         self.tiers: List[Tier] = []
         self.configurations: List[Configuration] = []
@@ -392,8 +393,25 @@ class Session:
                 self.dispatch(t)
 
     def dispatch(self, task: TaskInfo) -> None:
-        """session.go:305-329 — bind through the cache."""
-        self.cache.bind_volumes(task)
+        """session.go:305-329 — bind through the cache.  A volume-bind
+        failure unwinds the allocation and resyncs from API truth (same
+        discipline as Statement._commit_allocate) so session state never
+        holds a half-dispatched task."""
+        try:
+            self.cache.bind_volumes(task)
+        except Exception as e:  # noqa: BLE001
+            log.error(
+                "bind volumes of %s/%s failed: %s", task.namespace, task.name, e
+            )
+            job = self.jobs.get(task.job)
+            if job is not None:
+                job.update_task_status(task, TaskStatus.Pending)
+            node = self.nodes.get(task.node_name)
+            if node is not None:
+                node.remove_task(task)
+            self._fire_deallocate(task)
+            self.cache.resync_task(task)
+            return
         self.cache.bind(task, task.node_name)
         job = self.jobs.get(task.job)
         if job is None:

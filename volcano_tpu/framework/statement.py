@@ -91,7 +91,17 @@ class Statement:
         self.operations.append(("allocate", (task, hostname)))
 
     def _commit_allocate(self, task: TaskInfo, hostname: str) -> None:
-        self.ssn.cache.bind_volumes(task)
+        try:
+            self.ssn.cache.bind_volumes(task)
+        except Exception as e:  # noqa: BLE001 — statement.go:263-270: a
+            # volume-bind failure unwinds the allocation and resyncs from
+            # API truth instead of binding a pod whose volumes never came
+            log.error(
+                "bind volumes of %s/%s failed: %s", task.namespace, task.name, e
+            )
+            self._unallocate(task)
+            self.ssn.cache.resync_task(task)
+            return
         self.ssn.cache.bind(task, task.node_name)
         job = self.ssn.jobs.get(task.job)
         if job is not None:

@@ -108,6 +108,27 @@ class PredicatesPlugin(Plugin):
                 ):
                     raise FitError(task, node, reasons.POD_AFFINITY_MISMATCH)
 
+            # Volume binding (the vendored VolumeBindingChecker /
+            # FindPodVolumes analogue): every referenced PVC must exist
+            # and be Bound or dynamically provisionable (storage class).
+            for vol in pod.spec.volumes:
+                ref = vol.source.get("persistentVolumeClaim")
+                if not ref or not ref.get("claimName"):
+                    continue
+                key = f"{pod.metadata.namespace}/{ref['claimName']}"
+                pvc = ssn.pvcs.get(key)
+                if pvc is None:
+                    raise FitError(
+                        task, node, f'persistentvolumeclaim "{key}" not found'
+                    )
+                if pvc.status.get("phase") != "Bound" and not pvc.spec.get(
+                    "storageClassName"
+                ):
+                    raise FitError(
+                        task, node,
+                        "pod has unbound immediate PersistentVolumeClaims",
+                    )
+
         ssn.add_predicate_fn(self.name(), predicate_fn)
 
     @staticmethod

@@ -26,6 +26,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
         if self.path == "/healthz":
+            check = getattr(self.server, "health_check", None)
+            if check is not None and not check():
+                body = b"unhealthy"
+                self.send_response(503)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             body = b"ok"
             ctype = "text/plain"
         elif self.path == "/metrics":
@@ -49,10 +58,19 @@ class ServingServer:
     """Threaded healthz+metrics server.  ``port=0`` binds an ephemeral
     port (read it back from ``.port`` after start)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, registry=None):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        registry=None,
+        health_check=None,
+    ):
         self._host = host
         self._port = port
         self._registry = registry if registry is not None else metrics.registry
+        #: optional () -> bool; False turns /healthz into a 503 (liveness
+        #: must reflect the daemon's loop, not just the process)
+        self._health_check = health_check
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -64,6 +82,7 @@ class ServingServer:
     def start(self) -> "ServingServer":
         self._httpd = ThreadingHTTPServer((self._host, self._port), _Handler)
         self._httpd.registry = self._registry
+        self._httpd.health_check = self._health_check
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="vtpu-serving", daemon=True
         )

@@ -103,7 +103,15 @@ class LeaderElector:
         cm, rec = self._read()
         holder = rec.get("holderIdentity") if rec else None
         renew = float(rec.get("renewTime", 0.0)) if rec else 0.0
-        expired = now - renew > self.lease_duration
+        # Expiry is judged by the HOLDER's advertised duration (stored in
+        # the record), not the reader's own config — otherwise a standby
+        # configured with a shorter lease could steal a live lease.
+        held_duration = (
+            float(rec.get("leaseDurationSeconds", self.lease_duration))
+            if rec
+            else self.lease_duration
+        )
+        expired = now - renew > held_duration
 
         if cm is not None and holder not in (None, "", self.identity) and not expired:
             return False  # someone else holds a live lease
