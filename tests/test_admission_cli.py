@@ -225,3 +225,134 @@ spec:
         assert vtctl(["job", "run", "-f", str(yaml_file)], api, out) == 0
         job = VolcanoClient(api).get_job("default", "yamljob")
         assert job is not None and job.spec.min_available == 2
+
+
+def _job_with_template(container=None, restart_policy="OnFailure"):
+    return batch.Job(
+        metadata=core.ObjectMeta(name="j", namespace="ns"),
+        spec=batch.JobSpec(
+            min_available=1,
+            tasks=[
+                batch.TaskSpec(
+                    name="worker",
+                    replicas=1,
+                    template=core.PodTemplateSpec(
+                        spec=core.PodSpec(
+                            containers=[container or core.Container()],
+                            restart_policy=restart_policy,
+                        )
+                    ),
+                )
+            ],
+        ),
+    )
+
+
+class TestValidateTaskTemplate:
+    """admit_job.go:194+ — the k8s pod-template validator depth
+    (admit_job_test.go template cases)."""
+
+    def test_invalid_container_name_denied(self):
+        job = _job_with_template(core.Container(name="Bad_Name"))
+        with pytest.raises(AdmissionError, match="DNS-1123"):
+            validate_job(job)
+
+    def test_duplicate_container_names_denied(self):
+        job = _job_with_template()
+        job.spec.tasks[0].template.spec.containers = [
+            core.Container(name="main"),
+            core.Container(name="main"),
+        ]
+        with pytest.raises(AdmissionError, match="duplicate container name"):
+            validate_job(job)
+
+    def test_bad_quantity_denied(self):
+        job = _job_with_template(
+            core.Container(resources={"requests": {"cpu": "not-a-cpu"}})
+        )
+        with pytest.raises(AdmissionError, match="invalid quantity"):
+            validate_job(job)
+
+    def test_requests_exceed_limits_denied(self):
+        job = _job_with_template(
+            core.Container(
+                resources={"requests": {"cpu": "2"}, "limits": {"cpu": "1"}}
+            )
+        )
+        with pytest.raises(AdmissionError, match="less than or equal to the limit"):
+            validate_job(job)
+
+    def test_requests_within_limits_allowed(self):
+        validate_job(
+            _job_with_template(
+                core.Container(
+                    resources={
+                        "requests": {"cpu": "500m", "memory": "1Gi"},
+                        "limits": {"cpu": "1", "memory": "2Gi"},
+                    }
+                )
+            )
+        )
+
+    def test_bad_restart_policy_denied(self):
+        job = _job_with_template(restart_policy="WheneverConvenient")
+        with pytest.raises(AdmissionError, match="restartPolicy"):
+            validate_job(job)
+
+    def test_port_out_of_range_denied(self):
+        job = _job_with_template(
+            core.Container(ports=[core.ContainerPort(container_port=70000)])
+        )
+        with pytest.raises(AdmissionError, match="between 1 and 65535"):
+            validate_job(job)
+
+    def test_duplicate_ports_denied(self):
+        job = _job_with_template(
+            core.Container(
+                ports=[
+                    core.ContainerPort(container_port=8080),
+                    core.ContainerPort(container_port=8080),
+                ]
+            )
+        )
+        with pytest.raises(AdmissionError, match="duplicate port"):
+            validate_job(job)
+
+    def test_duplicate_port_names_denied(self):
+        job = _job_with_template(
+            core.Container(
+                ports=[
+                    core.ContainerPort(container_port=80, name="web"),
+                    core.ContainerPort(container_port=81, name="web"),
+                ]
+            )
+        )
+        with pytest.raises(AdmissionError, match="duplicate port name"):
+            validate_job(job)
+
+    def test_bad_protocol_denied(self):
+        job = _job_with_template(
+            core.Container(
+                ports=[core.ContainerPort(container_port=80, protocol="HTTPish")]
+            )
+        )
+        with pytest.raises(AdmissionError, match="unsupported protocol"):
+            validate_job(job)
+
+    def test_init_container_bad_quantity_denied(self):
+        job = _job_with_template()
+        job.spec.tasks[0].template.spec.init_containers = [
+            core.Container(name="init", resources={"requests": {"cpu": "oops"}})
+        ]
+        with pytest.raises(AdmissionError, match="initContainers.*invalid quantity"):
+            validate_job(job)
+
+    def test_same_port_in_different_containers_allowed(self):
+        """k8s allows two containers to declare the same containerPort —
+        only duplicates within one container are denied."""
+        job = _job_with_template()
+        job.spec.tasks[0].template.spec.containers = [
+            core.Container(name="app", ports=[core.ContainerPort(container_port=8080)]),
+            core.Container(name="metrics", ports=[core.ContainerPort(container_port=8080)]),
+        ]
+        validate_job(job)

@@ -482,3 +482,112 @@ class TestE2EEventAggregation:
         events = cluster.api.list("Event", "default")
         assert len(events) == 1
         assert events[0].count == 5
+
+
+class TestE2EErrorHandlingMatrix:
+    """job_error_handling.go restart/abort/terminate/complete/exit-code
+    policy matrix (VERDICT r2 #8) — each case drives the full loop."""
+
+    def _run_with_policy(self, policies, fail_pod=None,
+                         phase="Failed", exit_code=None, name="mx"):
+        cluster = Cluster()
+        submit(cluster, name=name, policies=policies)
+        cluster.tick()
+        assert cluster.vc.get_job("default", name).status.running == 3
+        cluster.kubelet.finish("default", fail_pod or f"{name}-worker-1",
+                               phase=phase, exit_code=exit_code)
+        cluster.tick(rounds=5)
+        return cluster, cluster.vc.get_job("default", name)
+
+    def test_abort_job_on_pod_failed(self):
+        cluster, job = self._run_with_policy(
+            [batch.LifecyclePolicy(event=batch.POD_FAILED_EVENT,
+                                   action=batch.ABORT_JOB_ACTION)]
+        )
+        assert job.status.state.phase == batch.JOB_ABORTED
+        # aborted (PodRetainPhaseSoft): running pods retained, none bound anew
+        assert job.status.running == 0
+
+    def test_terminate_job_on_pod_failed(self):
+        cluster, job = self._run_with_policy(
+            [batch.LifecyclePolicy(event=batch.POD_FAILED_EVENT,
+                                   action=batch.TERMINATE_JOB_ACTION)]
+        )
+        assert job.status.state.phase == batch.JOB_TERMINATED
+
+    def test_restart_job_on_pod_evicted(self):
+        cluster = Cluster()
+        submit(cluster, name="evct", policies=[
+            batch.LifecyclePolicy(event=batch.POD_EVICTED_EVENT,
+                                  action=batch.RESTART_JOB_ACTION)
+        ])
+        cluster.tick()
+        # evict = delete a running pod out from under the job
+        cluster.kube.delete_pod("default", "evct-worker-0")
+        cluster.tick(rounds=5)
+        job = cluster.vc.get_job("default", "evct")
+        assert job.status.retry_count >= 1
+        assert job.status.state.phase == batch.JOB_RUNNING
+
+    def test_complete_job_on_task_completed(self):
+        cluster = Cluster()
+        submit(cluster, name="cmp", min_available=1, policies=[
+            batch.LifecyclePolicy(event=batch.TASK_COMPLETED_EVENT,
+                                  action=batch.COMPLETE_JOB_ACTION)
+        ])
+        cluster.tick()
+        for i in range(3):
+            cluster.kubelet.finish("default", f"cmp-worker-{i}")
+        cluster.tick(rounds=5)
+        job = cluster.vc.get_job("default", "cmp")
+        assert job.status.state.phase == batch.JOB_COMPLETED
+
+    def test_exit_code_policy_matches_specific_code(self):
+        cluster, job = self._run_with_policy(
+            [batch.LifecyclePolicy(exit_code=3, action=batch.ABORT_JOB_ACTION)],
+            exit_code=3,
+        )
+        assert job.status.state.phase == batch.JOB_ABORTED
+
+    def test_exit_code_policy_ignores_other_codes(self):
+        cluster, job = self._run_with_policy(
+            [batch.LifecyclePolicy(exit_code=3, action=batch.ABORT_JOB_ACTION)],
+            exit_code=137, name="mx2",
+        )
+        # 137 doesn't match the 3-policy → default handling (no abort)
+        assert job.status.state.phase != batch.JOB_ABORTED
+
+    def test_task_level_policy_overrides_job_level(self):
+        """applyPolicies: task-level policy wins over job-level
+        (job_controller_util.go:123-179)."""
+        cluster = Cluster()
+        task = batch.TaskSpec(
+            name="worker",
+            replicas=3,
+            policies=[batch.LifecyclePolicy(event=batch.POD_FAILED_EVENT,
+                                            action=batch.RESTART_JOB_ACTION)],
+            template=core.PodTemplateSpec(
+                spec=core.PodSpec(
+                    containers=[core.Container(
+                        resources={"requests": {"cpu": "1", "memory": "1Gi"}})]
+                )
+            ),
+        )
+        job = batch.Job(
+            metadata=core.ObjectMeta(name="ovr", namespace="default"),
+            spec=batch.JobSpec(
+                min_available=3,
+                tasks=[task],
+                policies=[batch.LifecyclePolicy(event=batch.POD_FAILED_EVENT,
+                                                action=batch.ABORT_JOB_ACTION)],
+            ),
+        )
+        cluster.vc.create_job(job)
+        cluster.tick()
+        cluster.kubelet.finish("default", "ovr-worker-1", phase="Failed",
+                               exit_code=1)
+        cluster.tick(rounds=5)
+        got = cluster.vc.get_job("default", "ovr")
+        # task policy (RestartJob) applied, not the job-level AbortJob
+        assert got.status.state.phase == batch.JOB_RUNNING
+        assert got.status.retry_count >= 1

@@ -62,6 +62,94 @@ def _validate_policies(policies: List[batch.LifecyclePolicy], path: str) -> List
     return msgs
 
 
+_VALID_RESTART_POLICIES = {"", "Always", "OnFailure", "Never"}
+_VALID_PROTOCOLS = {"TCP", "UDP", "SCTP"}
+
+
+def _validate_task_template(task: batch.TaskSpec, index: int) -> List[str]:
+    """admit_job.go:194+ validateTaskTemplate — the used subset of the
+    k8s pod-template validators: container identity, resource quantity
+    parse + requests≤limits, restart policy, port legality."""
+    from volcano_tpu.apis import quantity
+
+    msgs: List[str] = []
+    path = f"spec.tasks[{index}].template"
+    spec = task.template.spec
+
+    if spec.restart_policy not in _VALID_RESTART_POLICIES:
+        msgs.append(
+            f"{path}.spec.restartPolicy: unsupported value "
+            f"{spec.restart_policy!r};"
+        )
+
+    container_names = set()
+    all_containers = [
+        (f"{path}.spec.initContainers[{ci}]", c)
+        for ci, c in enumerate(getattr(spec, "init_containers", []) or [])
+    ] + [
+        (f"{path}.spec.containers[{ci}]", c)
+        for ci, c in enumerate(spec.containers)
+    ]
+    for cpath, container in all_containers:
+        # port dedup is PER CONTAINER (k8s allows two containers to
+        # declare the same containerPort; only hostPort conflicts matter
+        # across containers, which scheduling handles)
+        port_keys = set()
+        port_names = set()
+        if not container.name or not is_dns1123_label(container.name):
+            msgs.append(f"{cpath}.name: must be a valid DNS-1123 label;")
+        if container.name in container_names:
+            msgs.append(f"{cpath}.name: duplicate container name {container.name!r};")
+        container_names.add(container.name)
+
+        resources = container.resources or {}
+        parsed = {}
+        for field_name in ("requests", "limits"):
+            for res, value in (resources.get(field_name) or {}).items():
+                try:
+                    parsed[(field_name, res)] = quantity.parse_quantity(value)
+                except (ValueError, TypeError):
+                    msgs.append(
+                        f"{cpath}.resources.{field_name}[{res}]: "
+                        f"invalid quantity {value!r};"
+                    )
+                    continue
+                if parsed[(field_name, res)] < 0:
+                    msgs.append(
+                        f"{cpath}.resources.{field_name}[{res}]: "
+                        "must be non-negative;"
+                    )
+        for res in resources.get("requests") or {}:
+            req = parsed.get(("requests", res))
+            lim = parsed.get(("limits", res))
+            if req is not None and lim is not None and req > lim:
+                msgs.append(
+                    f"{cpath}.resources.requests[{res}]: "
+                    "must be less than or equal to the limit;"
+                )
+
+        for pi, port in enumerate(container.ports):
+            ppath = f"{cpath}.ports[{pi}]"
+            if not (0 < port.container_port < 65536):
+                msgs.append(f"{ppath}.containerPort: must be between 1 and 65535;")
+            if port.host_port and not (0 < port.host_port < 65536):
+                msgs.append(f"{ppath}.hostPort: must be between 1 and 65535;")
+            if port.protocol and port.protocol not in _VALID_PROTOCOLS:
+                msgs.append(f"{ppath}.protocol: unsupported protocol {port.protocol!r};")
+            if port.name:
+                if port.name in port_names:
+                    msgs.append(f"{ppath}.name: duplicate port name {port.name!r};")
+                port_names.add(port.name)
+            key = (port.container_port, port.protocol or "TCP")
+            if key in port_keys:
+                msgs.append(
+                    f"{ppath}.containerPort: duplicate port "
+                    f"{port.container_port}/{port.protocol or 'TCP'};"
+                )
+            port_keys.add(key)
+    return msgs
+
+
 def validate_job(job: batch.Job, api: Optional[APIServer] = None) -> None:
     """admit_job.go:103-192 — raises AdmissionError on the first deny."""
     if job.spec.min_available <= 0:
@@ -92,6 +180,8 @@ def validate_job(job: batch.Job, api: Optional[APIServer] = None) -> None:
         msgs.extend(_validate_policies(task.policies, f"spec.tasks[{index}].policies"))
         if not task.template.spec.containers:
             msgs.append(f"task {task.name} has no containers in pod template;")
+        else:
+            msgs.extend(_validate_task_template(task, index))
 
     if total_replicas < job.spec.min_available:
         msgs.append("'minAvailable' should not be greater than total replicas in tasks;")
