@@ -1,0 +1,177 @@
+"""Cache event-handler coverage — the table-driven style of the
+reference's event_handlers_test.go (1,141 LoC): pod/node/podgroup/queue
+transitions through the handler surface and their effect on cache
+state, node accounting, and snapshots."""
+
+from __future__ import annotations
+
+import pytest
+
+from volcano_tpu.api import TaskStatus
+from volcano_tpu.apis import core, scheduling
+
+from tests.builders import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_priority_class,
+    build_queue,
+)
+from tests.scheduler_helpers import make_cache
+
+
+def _cache(**kw):
+    defaults = dict(
+        nodes=[build_node("n0", {"cpu": "8", "memory": "16G"})],
+        pods=[], pod_groups=[], queues=[build_queue("q")],
+    )
+    defaults.update(kw)
+    return make_cache(**defaults)
+
+
+class TestPodHandlers:
+    def test_pending_pod_joins_job_as_pending(self):
+        cache = _cache(pod_groups=[build_pod_group("ns", "pg", 1, queue="q")])
+        cache.add_pod(build_pod("ns", "p", "", {"cpu": "1", "memory": "1G"}, group="pg"))
+        job = next(iter(cache.jobs.values()))
+        assert TaskStatus.Pending in job.task_status_index
+
+    def test_running_pod_charges_node(self):
+        cache = _cache()
+        cache.add_pod(build_pod("ns", "p", "n0", {"cpu": "2", "memory": "4G"},
+                                phase="Running"))
+        node = cache.nodes["n0"]
+        assert node.used.milli_cpu == 2000
+        assert node.idle.milli_cpu == 6000
+
+    def test_update_pod_phase_transition_moves_status(self):
+        cache = _cache(pod_groups=[build_pod_group("ns", "pg", 1, queue="q")])
+        pod = build_pod("ns", "p", "n0", {"cpu": "1", "memory": "1G"},
+                        phase="Running", group="pg")
+        cache.add_pod(pod)
+        done = pod.clone()
+        done.status.phase = "Succeeded"
+        cache.update_pod(pod, done)
+        job = next(iter(cache.jobs.values()))
+        assert TaskStatus.Succeeded in job.task_status_index
+        assert TaskStatus.Running not in job.task_status_index
+        # succeeded pods release node resources (node accounting)
+        assert cache.nodes["n0"].used.milli_cpu == 0
+
+    def test_update_pod_gains_node_assignment(self):
+        """Pending → bound elsewhere (another scheduler instance won):
+        the task moves onto the node's books."""
+        cache = _cache(pod_groups=[build_pod_group("ns", "pg", 1, queue="q")])
+        pod = build_pod("ns", "p", "", {"cpu": "1", "memory": "1G"}, group="pg")
+        cache.add_pod(pod)
+        bound = pod.clone()
+        bound.spec.node_name = "n0"
+        bound.status.phase = "Running"
+        cache.update_pod(pod, bound)
+        assert cache.nodes["n0"].used.milli_cpu == 1000
+
+    def test_delete_pod_releases_node(self):
+        cache = _cache()
+        pod = build_pod("ns", "p", "n0", {"cpu": "2", "memory": "4G"}, phase="Running")
+        cache.add_pod(pod)
+        cache.delete_pod(pod)
+        assert cache.nodes["n0"].used.milli_cpu == 0
+
+    def test_foreign_scheduler_pending_pod_still_charges_when_running(self):
+        """Pods of other schedulers participate in node accounting once
+        placed (the cache mirrors cluster truth), but their pending pods
+        are not scheduling work for this scheduler."""
+        cache = _cache()
+        pod = build_pod("ns", "p", "n0", {"cpu": "1", "memory": "1G"}, phase="Running")
+        pod.spec.scheduler_name = "other-scheduler"
+        cache.add_pod(pod)
+        assert cache.nodes["n0"].used.milli_cpu == 1000
+
+
+class TestNodeHandlers:
+    def test_update_node_alloc_change(self):
+        cache = _cache()
+        new = build_node("n0", {"cpu": "16", "memory": "32G"})
+        cache.update_node(None, new)
+        assert cache.nodes["n0"].allocatable.milli_cpu == 16000
+
+    def test_delete_node_removes_from_cache(self):
+        cache = _cache()
+        cache.delete_node(cache.nodes["n0"].node)
+        assert "n0" not in cache.nodes
+
+    def test_unschedulable_node_vetoed_by_predicates_not_snapshot(self):
+        """cordoned nodes stay in the snapshot (cluster truth) — the
+        predicates plugin is what refuses placements on them."""
+        cache = _cache()
+        bad = build_node("n1", {"cpu": "4", "memory": "8G"}, unschedulable=True)
+        cache.add_node(bad)
+        snap = cache.snapshot()
+        assert "n1" in snap.nodes
+        assert snap.nodes["n1"].node.spec.unschedulable
+
+    def test_over_allocated_node_excluded_from_snapshot(self):
+        cache = _cache()
+        cache.add_pod(build_pod("ns", "big", "n0", {"cpu": "100", "memory": "1G"},
+                                phase="Running"))
+        snap = cache.snapshot()
+        assert "n0" not in snap.nodes  # not ready() → filtered
+
+
+class TestSnapshotFiltering:
+    def test_job_without_podgroup_excluded(self):
+        cache = _cache()
+        cache.add_pod(build_pod("ns", "p", "", {"cpu": "1", "memory": "1G"},
+                                group="orphan-pg"))
+        snap = cache.snapshot()
+        assert not snap.jobs  # no scheduling spec → not schedulable
+
+    def test_job_with_unknown_queue_excluded(self):
+        cache = _cache(pod_groups=[build_pod_group("ns", "pg", 1, queue="ghost")])
+        cache.add_pod(build_pod("ns", "p", "", {"cpu": "1", "memory": "1G"}, group="pg"))
+        snap = cache.snapshot()
+        assert not snap.jobs
+
+    def test_priority_class_resolution(self):
+        cache = _cache(
+            pod_groups=[build_pod_group("ns", "pg", 1, queue="q",
+                                        priority_class_name="high")],
+            priority_classes=[build_priority_class("high", 500)],
+        )
+        cache.add_pod(build_pod("ns", "p", "", {"cpu": "1", "memory": "1G"}, group="pg"))
+        snap = cache.snapshot()
+        job = next(iter(snap.jobs.values()))
+        assert job.priority == 500
+
+    def test_global_default_priority_class(self):
+        pc = build_priority_class("std", 7)
+        pc.global_default = True
+        cache = _cache(
+            pod_groups=[build_pod_group("ns", "pg", 1, queue="q")],
+            priority_classes=[pc],
+        )
+        cache.add_pod(build_pod("ns", "p", "", {"cpu": "1", "memory": "1G"}, group="pg"))
+        snap = cache.snapshot()
+        assert next(iter(snap.jobs.values())).priority == 7
+
+
+class TestPodGroupQueueHandlers:
+    def test_delete_pod_group_drops_empty_job(self):
+        cache = _cache(pod_groups=[build_pod_group("ns", "pg", 1, queue="q")])
+        pg = next(iter(cache.jobs.values())).pod_group
+        cache.delete_pod_group(pg)
+        assert not cache.jobs
+
+    def test_delete_pod_group_keeps_job_with_tasks(self):
+        cache = _cache(pod_groups=[build_pod_group("ns", "pg", 1, queue="q")])
+        cache.add_pod(build_pod("ns", "p", "", {"cpu": "1", "memory": "1G"}, group="pg"))
+        pg = next(iter(cache.jobs.values())).pod_group
+        cache.delete_pod_group(pg)
+        job = next(iter(cache.jobs.values()))
+        assert job.pod_group is None and job.tasks
+
+    def test_queue_update_reflects_weight(self):
+        cache = _cache()
+        q = build_queue("q", weight=6)
+        cache.update_queue(None, q)
+        assert cache.queues["q"].weight == 6

@@ -1,0 +1,175 @@
+"""Plugin score-math tables — the numeric-expectation style of the
+reference's binpack_test.go plus drf/proportion cases: exact score and
+share values for known (request, used, capacity) inputs."""
+
+from __future__ import annotations
+
+import pytest
+
+from volcano_tpu.api import new_task_info
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.plugins.binpack import (
+    PriorityWeight,
+    bin_packing_score,
+    resource_bin_packing_score,
+)
+
+from tests.builders import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+from tests.scheduler_helpers import make_cache, tiers
+
+
+def _task(cpu, mem):
+    return new_task_info(build_pod("ns", "t", "", {"cpu": cpu, "memory": mem}))
+
+
+def _node(cpu, mem, used_cpu="0", used_mem="0"):
+    node = NodeInfo(build_node("bn", {"cpu": cpu, "memory": mem}))
+    if used_cpu != "0" or used_mem != "0":
+        t = new_task_info(
+            build_pod("ns", "filler", "bn", {"cpu": used_cpu, "memory": used_mem},
+                      phase="Running")
+        )
+        node.add_task(t)
+    return node
+
+
+class TestBinpackScoreTable:
+    """binpack_test.go numeric cases: score = Σ lane((used+req)/alloc×w)
+    / Σw × 10 × weight."""
+
+    @pytest.mark.parametrize(
+        "req_cpu,req_mem,used_cpu,used_mem,cap_cpu,cap_mem,expected",
+        [
+            # empty node, 1/8 cpu + 1/16 mem → ((0.125+0.0625)/2)*10 = 0.9375
+            ("1", "1Gi", "0", "0", "8", "16Gi", 0.9375),
+            # half-used node → ((5/8 + 9/16)/2)*10 = 5.9375
+            ("1", "1Gi", "4", "8Gi", "8", "16Gi", 5.9375),
+            # request overflows cpu → cpu lane 0, mem (1+8)/16/2*10 = 2.8125
+            ("8", "1Gi", "4", "8Gi", "8", "16Gi", 2.8125),
+            # perfect fill → ((8/8 + 16/16)/2)*10 = 10
+            ("4", "8Gi", "4", "8Gi", "8", "16Gi", 10.0),
+        ],
+    )
+    def test_default_weights(self, req_cpu, req_mem, used_cpu, used_mem,
+                             cap_cpu, cap_mem, expected):
+        score = bin_packing_score(
+            _task(req_cpu, req_mem),
+            _node(cap_cpu, cap_mem, used_cpu, used_mem),
+            PriorityWeight(),
+        )
+        assert score == pytest.approx(expected, abs=1e-9)
+
+    def test_weighted_lanes(self):
+        """cpu weight 2, memory weight 1: ((2*5/8 + 9/16)/3)*10."""
+        score = bin_packing_score(
+            _task("1", "1Gi"),
+            _node("8", "16Gi", "4", "8Gi"),
+            PriorityWeight(weight=1, cpu=2, memory=1),
+        )
+        assert score == pytest.approx((2 * 5 / 8 + 9 / 16) / 3 * 10, abs=1e-9)
+
+    def test_binpack_weight_scales_total(self):
+        base = bin_packing_score(_task("1", "1Gi"), _node("8", "16Gi"), PriorityWeight())
+        x5 = bin_packing_score(
+            _task("1", "1Gi"), _node("8", "16Gi"), PriorityWeight(weight=5)
+        )
+        assert x5 == pytest.approx(5 * base, abs=1e-9)
+
+    @pytest.mark.parametrize(
+        "requested,capacity,used,weight,expected",
+        [
+            (1000, 0, 0, 1, 0.0),       # zero capacity
+            (1000, 8000, 0, 0, 0.0),    # zero weight
+            (5000, 8000, 4000, 1, 0.0), # overflow
+            (1000, 8000, 3000, 2, 1.0), # (1000+3000)*2/8000
+        ],
+    )
+    def test_lane_score(self, requested, capacity, used, weight, expected):
+        assert resource_bin_packing_score(requested, capacity, used, weight) == expected
+
+
+class TestDrfShares:
+    def test_dominant_share_is_max_lane(self):
+        """drf.go:299-311 — share = max(allocated_r / total_r)."""
+        from volcano_tpu.plugins.drf import DrfPlugin
+        from volcano_tpu.api.resource import Resource
+
+        plugin = DrfPlugin({})
+        plugin.total_resource = Resource(milli_cpu=10_000, memory=100 * 2**30)
+        dominant, share = plugin._calculate_share(
+            Resource(milli_cpu=2_000, memory=50 * 2**30),
+            plugin.total_resource,
+        )
+        assert dominant == "memory" and share == pytest.approx(0.5)
+
+    def test_job_order_prefers_lower_share(self):
+        """Jobs with smaller dominant share schedule first (fairness)."""
+        cache = make_cache(
+            nodes=[build_node("n0", {"cpu": "10", "memory": "100G"})],
+            pods=[
+                build_pod("ns", "greedy-r", "n0", {"cpu": "1", "memory": "50G"},
+                          phase="Running", group="greedy"),
+                build_pod("ns", "greedy-p", "", {"cpu": "1", "memory": "1G"},
+                          group="greedy"),
+                build_pod("ns", "modest-p", "", {"cpu": "1", "memory": "1G"},
+                          group="modest"),
+            ],
+            pod_groups=[
+                build_pod_group("ns", "greedy", 1, queue="q"),
+                build_pod_group("ns", "modest", 1, queue="q"),
+            ],
+            queues=[build_queue("q")],
+        )
+        from volcano_tpu.framework.framework import close_session, open_session
+
+        ssn = open_session(
+            cache, tiers(["priority", "gang", "conformance"], ["drf"]), []
+        )
+        greedy = next(j for j in ssn.jobs.values() if "greedy" in j.name)
+        modest = next(j for j in ssn.jobs.values() if "modest" in j.name)
+        # modest (share 0) orders before greedy (share 0.5)
+        assert ssn.job_order_fn(modest, greedy)
+        assert not ssn.job_order_fn(greedy, modest)
+        close_session(ssn)
+
+
+class TestProportionDeserved:
+    def _session(self, weights, node_cpu="12", node_mem="12G"):
+        pods, pgs, queues = [], [], []
+        for i, w in enumerate(weights):
+            queues.append(build_queue(f"q{i}", weight=w))
+            pgs.append(build_pod_group("ns", f"pg{i}", 1, queue=f"q{i}"))
+            pods.append(
+                build_pod("ns", f"p{i}", "", {"cpu": "100", "memory": "1G"},
+                          group=f"pg{i}")
+            )
+        cache = make_cache(
+            nodes=[build_node("n0", {"cpu": node_cpu, "memory": node_mem})],
+            pods=pods, pod_groups=pgs, queues=queues,
+        )
+        from volcano_tpu.framework.framework import open_session
+
+        return open_session(
+            cache, tiers(["priority", "gang", "conformance"], ["proportion"]), []
+        )
+
+    def test_water_filling_splits_by_weight(self):
+        """proportion.go:104-157 — demand exceeds supply: deserved splits
+        cpu 12 → 4/8 for weights 1:2 (both queues saturate their ask)."""
+        ssn = self._session([1, 2])
+        plugin = ssn.plugins["proportion"]
+        attrs = {a.name: a for a in plugin.queue_opts.values()}
+        assert attrs["q0"].deserved.milli_cpu == pytest.approx(4000)
+        assert attrs["q1"].deserved.milli_cpu == pytest.approx(8000)
+
+    def test_equal_weights_split_evenly(self):
+        ssn = self._session([1, 1])
+        plugin = ssn.plugins["proportion"]
+        attrs = {a.name: a for a in plugin.queue_opts.values()}
+        assert attrs["q0"].deserved.milli_cpu == pytest.approx(6000)
+        assert attrs["q1"].deserved.milli_cpu == pytest.approx(6000)

@@ -1,0 +1,103 @@
+"""Dual-version scheduling API: v1alpha1 shims convert through the
+scheme to the hub, and the cache's v1alpha1 handler set schedules
+v1alpha1-created objects identically (cache.go:393-424)."""
+
+from __future__ import annotations
+
+from volcano_tpu.apis import core, scheduling
+from volcano_tpu.apis.scheme import (
+    PodGroupV1alpha1,
+    QueueSpecV1alpha1,
+    QueueV1alpha1,
+    pod_group_hub_to_v1alpha1,
+    pod_group_v1alpha1_to_hub,
+    queue_hub_to_v1alpha1,
+    queue_v1alpha1_to_hub,
+)
+
+from tests.builders import build_node, build_pod
+from tests.scheduler_helpers import make_cache
+
+
+class TestConversions:
+    def test_queue_v1alpha1_roundtrip_defaults_state_open(self):
+        q1 = QueueV1alpha1(
+            metadata=core.ObjectMeta(name="q", namespace=""),
+            spec=QueueSpecV1alpha1(weight=4, capability={"cpu": "100"}),
+        )
+        hub = queue_v1alpha1_to_hub(q1)
+        assert hub.spec.state == scheduling.QUEUE_STATE_OPEN
+        assert hub.spec.weight == 4 and hub.spec.capability == {"cpu": "100"}
+        back = queue_hub_to_v1alpha1(hub)
+        assert back.spec.weight == 4
+        assert not hasattr(back.spec, "state")  # v1alpha1 has no QueueState
+
+    def test_pod_group_roundtrip(self):
+        pg1 = PodGroupV1alpha1(
+            metadata=core.ObjectMeta(name="pg", namespace="ns"),
+            spec=scheduling.PodGroupSpec(min_member=3, queue="q"),
+        )
+        hub = pod_group_v1alpha1_to_hub(pg1)
+        assert hub.kind == "PodGroup"
+        assert hub.spec.min_member == 3
+        back = pod_group_hub_to_v1alpha1(hub)
+        assert back.spec.queue == "q"
+
+    def test_hub_to_v1alpha1_drops_v2_only_status(self):
+        hub = scheduling.Queue(
+            metadata=core.ObjectMeta(name="q", namespace=""),
+            status=scheduling.QueueStatus(state="Open", inqueue=7, running=2),
+        )
+        back = queue_hub_to_v1alpha1(hub)
+        assert back.status.running == 2
+        assert not hasattr(back.status, "inqueue")
+
+
+class TestCacheDualVersionHandlers:
+    def test_v1alpha1_objects_schedule_identically(self):
+        """Feed the cache through the v1alpha1 handler set; the session
+        must see a normal hub queue/podgroup and place the pod."""
+        cache = make_cache(
+            nodes=[build_node("n0", {"cpu": "4", "memory": "8G"})],
+            pods=[], pod_groups=[], queues=[],
+        )
+        cache.add_queue_v1alpha1(
+            QueueV1alpha1(metadata=core.ObjectMeta(name="q1", namespace=""))
+        )
+        cache.add_pod_group_v1alpha1(
+            PodGroupV1alpha1(
+                metadata=core.ObjectMeta(name="pg1", namespace="ns"),
+                spec=scheduling.PodGroupSpec(min_member=1, queue="q1"),
+                status=scheduling.PodGroupStatus(
+                    phase=scheduling.POD_GROUP_INQUEUE
+                ),
+            )
+        )
+        cache.add_pod(build_pod("ns", "p1", "", {"cpu": "1", "memory": "1G"},
+                                group="pg1"))
+
+        from volcano_tpu.actions.allocate import AllocateAction
+        from volcano_tpu.framework.framework import close_session, open_session
+        from tests.scheduler_helpers import tiers
+
+        ssn = open_session(
+            cache,
+            tiers(["priority", "gang", "conformance"],
+                  ["drf", "predicates", "proportion", "nodeorder", "binpack"]),
+            [],
+        )
+        assert "q1" in ssn.queues
+        AllocateAction().execute(ssn)
+        close_session(ssn)
+        assert cache.binder.binds  # the v1alpha1-created pg scheduled
+
+    def test_v1alpha1_update_delete_handlers(self):
+        cache = make_cache(nodes=[], pods=[], pod_groups=[], queues=[])
+        q = QueueV1alpha1(metadata=core.ObjectMeta(name="q2", namespace=""))
+        cache.add_queue_v1alpha1(q)
+        assert "q2" in cache.queues
+        q.spec.weight = 9
+        cache.update_queue_v1alpha1(None, q)
+        assert cache.queues["q2"].weight == 9
+        cache.delete_queue_v1alpha1(q)
+        assert "q2" not in cache.queues

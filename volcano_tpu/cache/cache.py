@@ -24,7 +24,7 @@ from volcano_tpu.api import (
 )
 from volcano_tpu.api.job_info import get_job_id
 from volcano_tpu.api.queue_info import NamespaceCollection
-from volcano_tpu.apis import core, scheduling
+from volcano_tpu.apis import core, scheduling, scheme
 from volcano_tpu.cache.interface import Binder, Cache, Evictor, StatusUpdater
 from volcano_tpu.utils.logging import get_logger
 
@@ -232,6 +232,34 @@ class SchedulerCache(Cache):
                 # when tasks drain (cleanup worker in the reference).
                 if not job.tasks:
                     del self.jobs[pg.key()]
+
+    # ---- dual-version handlers (cache.go:393-424: the v1alpha1
+    # informer set converts BOTH old and new through the scheme, then
+    # delegates) ----
+
+    def add_pod_group_v1alpha1(self, pg) -> None:
+        self.add_pod_group(scheme.pod_group_v1alpha1_to_hub(pg))
+
+    def update_pod_group_v1alpha1(self, old_pg, new_pg) -> None:
+        self.update_pod_group(
+            scheme.pod_group_v1alpha1_to_hub(old_pg) if old_pg is not None else None,
+            scheme.pod_group_v1alpha1_to_hub(new_pg),
+        )
+
+    def delete_pod_group_v1alpha1(self, pg) -> None:
+        self.delete_pod_group(scheme.pod_group_v1alpha1_to_hub(pg))
+
+    def add_queue_v1alpha1(self, queue) -> None:
+        self.add_queue(scheme.queue_v1alpha1_to_hub(queue))
+
+    def update_queue_v1alpha1(self, old_queue, new_queue) -> None:
+        self.update_queue(
+            scheme.queue_v1alpha1_to_hub(old_queue) if old_queue is not None else None,
+            scheme.queue_v1alpha1_to_hub(new_queue),
+        )
+
+    def delete_queue_v1alpha1(self, queue) -> None:
+        self.delete_queue(scheme.queue_v1alpha1_to_hub(queue))
 
     # ---- event handlers: queues (event_handlers.go:696-863) ----
 

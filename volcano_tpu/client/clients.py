@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from volcano_tpu.apis import batch, bus, core, scheduling
+from volcano_tpu.apis import batch, bus, core, scheduling, scheme
 from volcano_tpu.client.apiserver import ADDED, APIServer, DELETED, MODIFIED, NotFoundError
 
 
@@ -158,6 +158,23 @@ class VolcanoClient:
 
     def delete_pod_group(self, namespace: str, name: str) -> None:
         self.api.delete("PodGroup", namespace, name)
+
+    # versioned creates (the v1alpha1 client surface; objects convert
+    # through the scheme to the hub/storage version before the store —
+    # pkg/apis/scheduling/scheme semantics)
+    def create_pod_group_v1alpha1(self, pg):
+        """Returns the stored object converted BACK to v1alpha1 — a
+        versioned clientset is uniformly versioned on create and get."""
+        hub = self.api.create(scheme.pod_group_v1alpha1_to_hub(pg))
+        return scheme.pod_group_hub_to_v1alpha1(hub)
+
+    def create_queue_v1alpha1(self, queue):
+        hub = self.api.create(scheme.queue_v1alpha1_to_hub(queue))
+        return scheme.queue_hub_to_v1alpha1(hub)
+
+    def get_queue_v1alpha1(self, name: str):
+        q = self.get_queue(name)
+        return scheme.queue_hub_to_v1alpha1(q) if q is not None else None
 
     # queues
     def create_queue(self, queue: scheduling.Queue) -> scheduling.Queue:
