@@ -212,6 +212,70 @@ def bench_preempt_config(name: str, kwargs: dict, iters: int = 3) -> dict:
     }
 
 
+def run_equivalence_check() -> int:
+    """--check: compiled-backend equivalence gates (ADVICE r2: the
+    compiled Mosaic path needs coverage beyond interpret mode — this
+    runs the REAL backend, wherever bench runs).  Exit 0 iff every
+    formulation agrees exactly on seeded mid-scale sessions."""
+    import jax
+
+    from volcano_tpu import native
+    from volcano_tpu.ops.blocked import run_packed_blocked
+    from volcano_tpu.ops.kernels import run_packed
+    from volcano_tpu.ops.preempt_pack import preempt_dense
+    from volcano_tpu.ops.preempt_pallas import run_preempt_pallas
+    from volcano_tpu.ops.synthetic import generate_preempt_packed, generate_snapshot
+
+    backend = jax.default_backend()
+    failures = []
+
+    snap = generate_snapshot(
+        n_tasks=4_096, n_nodes=1_000, gang_size=8, seed=42,
+        label_classes=4, taint_fraction=0.1,
+    )
+    plain = run_packed(snap)
+    if not np.array_equal(plain, run_packed_blocked(snap)):
+        failures.append("blocked != plain")
+    if backend == "tpu":
+        from volcano_tpu.ops.pallas_session import run_packed_pallas
+
+        if not np.array_equal(plain, run_packed_pallas(snap)):
+            failures.append("pallas(compiled) != plain")
+    native_checked = native.load() is not None
+    if native_checked:
+        # RuntimeError from an AVAILABLE library is a failure, not a skip
+        try:
+            if not np.array_equal(plain, native.baseline_allocate(snap)):
+                failures.append("native != plain")
+        except RuntimeError as e:
+            failures.append(f"native allocate errored: {e}")
+
+    pk = generate_preempt_packed(n_victims=9_000, n_nodes=1_000,
+                                 n_preemptors=1_000, seed=42)
+    ev_d, pipe_d = preempt_dense(pk)
+    if backend == "tpu":
+        ev_p, pipe_p = run_preempt_pallas(pk)
+        if not (np.array_equal(ev_d, ev_p) and np.array_equal(pipe_d, pipe_p)):
+            failures.append("preempt pallas(compiled) != dense")
+    if native_checked:
+        try:
+            ev_n, pipe_n = native.baseline_preempt(pk)
+            if not (np.array_equal(ev_d, ev_n) and np.array_equal(pipe_d, pipe_n)):
+                failures.append("preempt native != dense")
+        except RuntimeError as e:
+            failures.append(f"native preempt errored: {e}")
+
+    print(json.dumps({
+        "check": "formulation_equivalence",
+        "backend": backend,
+        "compiled_pallas_checked": backend == "tpu",
+        "native_checked": native_checked,
+        "failures": failures,
+        "ok": not failures,
+    }))
+    return 1 if failures else 0
+
+
 def main() -> int:
     from volcano_tpu.ops.synthetic import BASELINE_CONFIGS
 
@@ -219,10 +283,16 @@ def main() -> int:
     parser.add_argument("--config", default=None, help="run one named config")
     parser.add_argument("--quick", action="store_true")
     parser.add_argument(
+        "--check", action="store_true",
+        help="run compiled-backend equivalence gates and exit",
+    )
+    parser.add_argument(
         "--all", action="store_true",
         help="(default) run every BASELINE config, headline last",
     )
     args = parser.parse_args()
+    if args.check:
+        return run_equivalence_check()
 
     headline = "50k_pods_10k_nodes_gang_predicates"
     if args.quick:

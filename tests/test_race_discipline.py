@@ -1,0 +1,101 @@
+"""Concurrency discipline — the `go test -race` analogue: hammer the
+cache's handler surface, snapshots, and side effects from many threads
+and assert state converges with no exceptions (the single-mutex +
+immutable-snapshot invariant, cache.go:74).  Plus the env-gated
+assertion helper (pkg/scheduler/util/assert)."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.utils import asserts
+
+from tests.builders import build_node, build_pod, build_pod_group, build_queue
+from tests.scheduler_helpers import make_cache
+
+
+class TestAssertf:
+    def test_lenient_by_default(self, monkeypatch, caplog):
+        monkeypatch.delenv(asserts.ENV_PANIC, raising=False)
+        asserts.assertf(False, "boom %d", 7)  # must not raise
+
+    def test_fatal_when_env_set(self, monkeypatch):
+        monkeypatch.setenv(asserts.ENV_PANIC, "1")
+        with pytest.raises(AssertionError, match="boom 7"):
+            asserts.assertf(False, "boom %d", 7)
+
+    def test_resource_sub_is_env_gated(self, monkeypatch):
+        monkeypatch.delenv(asserts.ENV_PANIC, raising=False)
+        r = Resource(milli_cpu=100)
+        r.sub(Resource(milli_cpu=500))  # logs, continues (reference default)
+        assert r.milli_cpu == -400
+        monkeypatch.setenv(asserts.ENV_PANIC, "1")
+        with pytest.raises(AssertionError):
+            Resource(milli_cpu=100).sub(Resource(milli_cpu=500))
+
+
+class TestCacheConcurrency:
+    def test_concurrent_handlers_and_snapshots_converge(self):
+        """16 writer threads feeding pod/node events + 4 snapshot readers;
+        no exceptions, final accounting exact."""
+        cache = make_cache(
+            nodes=[build_node(f"n{i}", {"cpu": "64", "memory": "128G"})
+                   for i in range(8)],
+            pods=[], pod_groups=[build_pod_group("ns", "pg", 1, queue="q")],
+            queues=[build_queue("q")],
+        )
+        errors = []
+        barrier = threading.Barrier(20)
+        PODS_PER_WORKER = 25
+
+        def writer(w):
+            try:
+                barrier.wait()
+                for i in range(PODS_PER_WORKER):
+                    pod = build_pod(
+                        "ns", f"p-{w}-{i}", f"n{(w + i) % 8}",
+                        {"cpu": "100m", "memory": "64Mi"},
+                        phase="Running", group="pg",
+                    )
+                    cache.add_pod(pod)
+                    if i % 3 == 0:
+                        cache.delete_pod(pod)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def reader():
+            try:
+                barrier.wait()
+                for _ in range(50):
+                    snap = cache.snapshot()
+                    # immutable-snapshot invariant: totals are coherent
+                    for node in snap.nodes.values():
+                        assert node.used.milli_cpu >= 0
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(w,)) for w in range(16)]
+        threads += [threading.Thread(target=reader) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+
+        kept = 16 * (PODS_PER_WORKER - -(-PODS_PER_WORKER // 3))
+        total_used = sum(n.used.milli_cpu for n in cache.nodes.values())
+        assert total_used == kept * 100
+
+    def test_snapshot_isolated_from_later_mutation(self):
+        cache = make_cache(
+            nodes=[build_node("n0", {"cpu": "8", "memory": "16G"})],
+            pods=[], pod_groups=[], queues=[build_queue("q")],
+        )
+        snap = cache.snapshot()
+        before = snap.nodes["n0"].used.milli_cpu
+        cache.add_pod(build_pod("ns", "p", "n0", {"cpu": "4", "memory": "1G"},
+                                phase="Running"))
+        assert snap.nodes["n0"].used.milli_cpu == before  # deep copy held

@@ -62,7 +62,13 @@ class TestArithmetic:
         a.sub(b)
         assert (a.milli_cpu, a.memory, a.scalars["nvidia.com/gpu"]) == (1000, 1024, 1000)
 
-    def test_sub_insufficient_asserts(self):
+    def test_sub_insufficient_asserts(self, monkeypatch):
+        """Env-gated like the reference's util/assert: fatal only under
+        the panic env var (tests/test_race_discipline.py covers the
+        lenient default)."""
+        from volcano_tpu.utils import asserts
+
+        monkeypatch.setenv(asserts.ENV_PANIC, "1")
         with pytest.raises(AssertionError):
             res(100).sub(res(500))
 

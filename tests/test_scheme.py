@@ -101,3 +101,41 @@ class TestCacheDualVersionHandlers:
         assert cache.queues["q2"].weight == 9
         cache.delete_queue_v1alpha1(q)
         assert "q2" not in cache.queues
+
+
+class TestDualInformerWire:
+    def test_raw_v1alpha1_objects_on_the_bus_schedule(self):
+        """A legacy writer stores RAW v1alpha1 objects (no converting
+        client): the scheduler's dual informer set must still feed the
+        cache and schedule the pod — the cache.go:393-424 behavior."""
+        import time
+
+        from volcano_tpu.cmd import ControllersDaemon, SchedulerDaemon
+        from volcano_tpu.client import APIServer, KubeClient
+        from tests.builders import build_node as bn
+
+        api = APIServer()
+        kube = KubeClient(api)
+        kube.create_node(bn("n0", {"cpu": "8", "memory": "16Gi"}))
+        scheduler = SchedulerDaemon(api, schedule_period=0.05).start()
+        try:
+            api.create(QueueV1alpha1(
+                metadata=core.ObjectMeta(name="raw-q", namespace="")))
+            api.create(PodGroupV1alpha1(
+                metadata=core.ObjectMeta(name="raw-pg", namespace="ns"),
+                spec=scheduling.PodGroupSpec(min_member=1, queue="raw-q"),
+                status=scheduling.PodGroupStatus(
+                    phase=scheduling.POD_GROUP_INQUEUE),
+            ))
+            kube.create_pod(build_pod("ns", "raw-pod", "",
+                                      {"cpu": "1", "memory": "1Gi"},
+                                      group="raw-pg"))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                p = kube.get_pod("ns", "raw-pod")
+                if p.spec.node_name:
+                    break
+                time.sleep(0.05)
+            assert kube.get_pod("ns", "raw-pod").spec.node_name == "n0"
+        finally:
+            scheduler.stop()

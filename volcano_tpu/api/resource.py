@@ -96,13 +96,23 @@ class Resource:
         return self
 
     def sub(self, rr: "Resource") -> "Resource":
-        """Subtract; asserts sufficiency like the reference (resource_info.go:146)."""
-        assert rr.less_equal(self), f"resource is not sufficient: {self} sub {rr}"
+        """Subtract; asserts sufficiency like the reference
+        (resource_info.go:146 via pkg/scheduler/util/assert — log and
+        continue by default, fatal under VOLCANO_TPU_PANIC_ON_UNEXPECTED)."""
+        from volcano_tpu.utils.asserts import assertf
+
+        assertf(
+            rr.less_equal(self),
+            "resource is not sufficient to do operation: <%s> sub <%s>",
+            self, rr,
+        )
         self.milli_cpu -= rr.milli_cpu
         self.memory -= rr.memory
-        if self.scalars:
-            for name, v in rr.scalars.items():
-                self.scalars[name] = self.scalars.get(name, 0.0) - v
+        # unconditional: with the lenient assert a scalar lane can go
+        # negative here, and the negative sentinel is what marks the node
+        # out-of-sync (same accounting as sub_unchecked below)
+        for name, v in rr.scalars.items():
+            self.scalars[name] = self.scalars.get(name, 0.0) - v
         return self
 
     def sub_unchecked(self, rr: "Resource") -> "Resource":

@@ -265,10 +265,31 @@ class SchedulerClient:
             elif event == DELETED:
                 cache.delete_pvc(old)
 
+        # dual informer set (cache.go:393-424): legacy writers that put
+        # RAW v1alpha1 objects on the bus feed the same cache through the
+        # converting handler set
+        def pod_groups_v1alpha1(event, old, new):
+            if event == ADDED:
+                cache.add_pod_group_v1alpha1(new)
+            elif event == MODIFIED:
+                cache.update_pod_group_v1alpha1(old, new)
+            elif event == DELETED:
+                cache.delete_pod_group_v1alpha1(old)
+
+        def queues_v1alpha1(event, old, new):
+            if event == ADDED:
+                cache.add_queue_v1alpha1(new)
+            elif event == MODIFIED:
+                cache.update_queue_v1alpha1(old, new)
+            elif event == DELETED:
+                cache.delete_queue_v1alpha1(old)
+
         self.api.watch("Pod", pods)
         self.api.watch("Node", nodes)
         self.api.watch("PodGroup", pod_groups)
         self.api.watch("Queue", queues)
+        self.api.watch("PodGroupV1alpha1", pod_groups_v1alpha1)
+        self.api.watch("QueueV1alpha1", queues_v1alpha1)
         self.api.watch("PriorityClass", priority_classes)
         self.api.watch("PersistentVolumeClaim", pvcs)
 

@@ -1,0 +1,33 @@
+"""Env-gated runtime assertions.
+
+Reference: pkg/scheduler/util/assert — ``Assertf`` logs the violation
+(with stack) and continues by default; setting the panic env var turns
+violations fatal for tests/CI.  The env var here is
+``VOLCANO_TPU_PANIC_ON_UNEXPECTED`` (the reference uses
+``PANIC_ON_UNEXPECTED``).
+"""
+
+from __future__ import annotations
+
+import os
+import traceback
+
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+ENV_PANIC = "VOLCANO_TPU_PANIC_ON_UNEXPECTED"
+
+
+def panic_on_unexpected() -> bool:
+    return os.environ.get(ENV_PANIC, "").lower() in ("1", "true", "yes")
+
+
+def assertf(condition: bool, msg: str, *args) -> None:
+    """Log-and-continue assertion; fatal when the panic env var is set."""
+    if condition:
+        return
+    rendered = msg % args if args else msg
+    if panic_on_unexpected():
+        raise AssertionError(rendered)
+    log.error("assertion failed: %s\n%s", rendered, "".join(traceback.format_stack(limit=6)))
