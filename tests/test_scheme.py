@@ -121,11 +121,11 @@ class TestDualInformerWire:
         try:
             api.create(QueueV1alpha1(
                 metadata=core.ObjectMeta(name="raw-q", namespace="")))
+            # starts PENDING: enqueue must promote it through the
+            # versioned-kind status writeback, then allocate binds
             api.create(PodGroupV1alpha1(
                 metadata=core.ObjectMeta(name="raw-pg", namespace="ns"),
                 spec=scheduling.PodGroupSpec(min_member=1, queue="raw-q"),
-                status=scheduling.PodGroupStatus(
-                    phase=scheduling.POD_GROUP_INQUEUE),
             ))
             kube.create_pod(build_pod("ns", "raw-pod", "",
                                       {"cpu": "1", "memory": "1Gi"},
@@ -137,5 +137,10 @@ class TestDualInformerWire:
                     break
                 time.sleep(0.05)
             assert kube.get_pod("ns", "raw-pod").spec.node_name == "n0"
+            # status wrote back to the RAW kind (not silently dropped)
+            stored = api.get("PodGroupV1alpha1", "ns", "raw-pg")
+            assert stored.status.phase in (
+                scheduling.POD_GROUP_INQUEUE, scheduling.POD_GROUP_RUNNING
+            )
         finally:
             scheduler.stop()

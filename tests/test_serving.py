@@ -147,3 +147,17 @@ class TestServingSurface:
         finally:
             e1.stop()
             e2.stop()
+
+
+def test_debug_stacks_endpoint():
+    """The pprof-goroutine analogue: /debug/stacks dumps live thread
+    stacks for hang forensics."""
+    from volcano_tpu.serving import ServingServer
+
+    srv = ServingServer().start()
+    try:
+        body = _get(srv.port, "/debug/stacks")
+        assert "MainThread" in body
+        assert "---" in body
+    finally:
+        srv.stop()

@@ -106,14 +106,7 @@ class Resource:
             "resource is not sufficient to do operation: <%s> sub <%s>",
             self, rr,
         )
-        self.milli_cpu -= rr.milli_cpu
-        self.memory -= rr.memory
-        # unconditional: with the lenient assert a scalar lane can go
-        # negative here, and the negative sentinel is what marks the node
-        # out-of-sync (same accounting as sub_unchecked below)
-        for name, v in rr.scalars.items():
-            self.scalars[name] = self.scalars.get(name, 0.0) - v
-        return self
+        return self.sub_unchecked(rr)
 
     def sub_unchecked(self, rr: "Resource") -> "Resource":
         """Subtract allowing negative lanes.

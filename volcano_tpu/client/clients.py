@@ -310,7 +310,14 @@ class SchedulerClient:
         try:
             return self.vc.update_pod_group(pg)
         except NotFoundError:
-            return None
+            # the object may live on the bus as a RAW v1alpha1 kind (the
+            # dual informer set read it); write status back to THAT kind
+            try:
+                v1 = scheme.pod_group_hub_to_v1alpha1(pg)
+                self.api.update_status(v1)
+                return pg
+            except NotFoundError:
+                return None
 
     def update_pvc(self, pvc: core.PersistentVolumeClaim) -> core.PersistentVolumeClaim:
         return self.kube.update_pvc(pvc)

@@ -27,6 +27,8 @@ class QueueController:
         self.queue: _queue.Queue = _queue.Queue()
         api.watch("Queue", self._on_queue)
         api.watch("PodGroup", self._on_pod_group)
+        # dual informer set: raw v1alpha1 podgroups count too
+        api.watch("PodGroupV1alpha1", self._on_pod_group)
         api.watch("Command", self._on_command)
 
     # ---- handlers (queue_controller.go:93-166) ----
@@ -98,7 +100,8 @@ class QueueController:
 
         # Recount podgroup phases (syncQueue :33-80).
         counts = {"pending": 0, "running": 0, "inqueue": 0, "unknown": 0}
-        for pg in self.vc.list_pod_groups():
+        all_pgs = self.vc.list_pod_groups() + self.api.list("PodGroupV1alpha1")
+        for pg in all_pgs:
             if pg.spec.queue != name:
                 continue
             phase = pg.status.phase

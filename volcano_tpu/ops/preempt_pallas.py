@@ -57,22 +57,31 @@ from volcano_tpu.ops.preempt_pack import PreemptPacked
 
 INT_BIG = np.int32(2**31 - 1)
 
+#: beyond this many distinct resreq rows, score inline instead of
+#: unrolling per-class precompute at kernel init
+SCORE_CLASS_CAP = 64
+
 K_BEGIN1, K_ATT1, K_END1, K_BURN2, K_PAD = 0, 1, 2, 5, 9
 
 
 def _make_preempt_kernel(
-    R: int, K: int, NS: int, JS: int, PS: int, SB: int,
+    R: int, K: int, NS: int, JS: int, PS: int, SB: int, SC: int,
     weights: ScoreWeights,
 ):
     """Kernel factory — R resource lanes, K victim slots per node, NS node
     sublanes, JS job sublanes, PS preemptor sublanes, SB schedule slots
-    per grid step."""
+    per grid step, SC score-class planes (node scores are static for
+    the whole pass — ``used`` never moves — so the per-class score plane
+    is computed ONCE at init instead of ~35 VPU ops per attempt).
+    ``SC`` is a PADDED bucket (bounds jit-cache churn); SC == 0 disables
+    the precompute (too many distinct rows) and scores inline."""
     shape = (NS, LANES)
 
     def kernel(
         tol_ref,  # SMEM [1, R]
         sched_ref,  # VMEM [SB, 4] i32 (grid-streamed): kind, job, task, pad
-        ptask_ref,  # VMEM [P_pad, R+1] f32 — resreq lanes, feas class
+        ptask_ref,  # VMEM [P_pad, R+2] f32 — resreq lanes, feas class, score class
+        screq_ref,  # VMEM [SC_pad, R] f32 — distinct resreq rows
         cf_ref,  # VMEM [C, NS, 128] f32 class feasibility (incl. node_ok)
         used_ref,  # VMEM [R, NS, 128] f32 (static across the pass)
         alloc_ref,  # VMEM [R, NS, 128] f32
@@ -99,6 +108,7 @@ def _make_preempt_kernel(
         wait_s,  # scratch [1, JS, 128] f32
         cursor_s,  # scratch [1, JS, 128] i32
         pipe_s,  # scratch [PS, 128] i32
+        spre_s,  # scratch [SC_pad, NS, 128] f32 — per-class score planes
         fi_sh,  # shadow [R, NS, 128]
         ncnt_sh,  # shadow [1, NS, 128]
         alive_sh,  # shadow [K, NS, 128]
@@ -122,6 +132,25 @@ def _make_preempt_kernel(
             wait_s[:] = jobsf_ref[1:2]
             cursor_s[:] = jobsi_ref[0:1]
             pipe_s[:] = jnp.full((PS, LANES), -1, jnp.int32)
+            # precompute the static per-class score planes
+            if SC:
+                sc_lane = jax.lax.broadcasted_iota(jnp.int32, (1, R), 1)
+                for c in range(SC):
+                    srow = screq_ref[c : c + 1, :]  # [1, R]
+                    rr_c = [
+                        jnp.sum(jnp.where(sc_lane == r, srow, 0.0))
+                        for r in range(R)
+                    ]
+                    req_c = [rr_c[r] + used_ref[r] for r in range(R)]
+                    spre_s[c] = score_planes(
+                        rr_c,
+                        req_c,
+                        lambda r: alloc_ref[r],
+                        lambda r: maxal_ref[r],
+                        lambda r: allocpos_ref[r],
+                        weights,
+                        shape,
+                    )
 
         nmax = naux_ref[1]
         idxp = (
@@ -136,7 +165,7 @@ def _make_preempt_kernel(
             jax.lax.broadcasted_iota(jnp.int32, (PS, LANES), 0) * LANES
             + jax.lax.broadcasted_iota(jnp.int32, (PS, LANES), 1)
         )
-        row_lane = jax.lax.broadcasted_iota(jnp.int32, (1, R + 1), 1)
+        row_lane = jax.lax.broadcasted_iota(jnp.int32, (1, R + 2), 1)
         row4 = jax.lax.broadcasted_iota(jnp.int32, (1, 4), 1)
 
         # scalar reads from the job planes (one-hot sum — no SMEM scalar
@@ -182,7 +211,7 @@ def _make_preempt_kernel(
             """One _preempt try (preempt.go:181-259) for preemptor task p
             of job j.  ``inter``: phase-1 cross-job filter (same queue,
             different job) vs phase-2 intra-job filter."""
-            trow = ptask_ref[pl.ds(p, 1), :]  # [1, R+1]
+            trow = ptask_ref[pl.ds(p, 1), :]  # [1, R+2]
 
             def col(r):
                 return jnp.sum(jnp.where(row_lane == r, trow, 0.0))
@@ -232,17 +261,22 @@ def _make_preempt_kernel(
                 & okl
             )
 
-            # node scores at static used (kernels.py node_scores math)
-            req = [rr[r] + used_ref[r] for r in range(R)]
-            total = score_planes(
-                rr,
-                req,
-                lambda r: alloc_ref[r],
-                lambda r: maxal_ref[r],
-                lambda r: allocpos_ref[r],
-                weights,
-                shape,
-            )
+            # node scores at static used: precomputed per-class plane,
+            # or inline when the class count exceeded the cap (SC == 0)
+            if SC:
+                scl = col(R + 1).astype(jnp.int32)
+                total = spre_s[scl]
+            else:
+                req = [rr[r] + used_ref[r] for r in range(R)]
+                total = score_planes(
+                    rr,
+                    req,
+                    lambda r: alloc_ref[r],
+                    lambda r: maxal_ref[r],
+                    lambda r: allocpos_ref[r],
+                    weights,
+                    shape,
+                )
             masked = jnp.where(valid, total, -jnp.inf)
             m = jnp.max(masked)
             okm = jnp.isfinite(m)
@@ -382,19 +416,41 @@ def build_schedule_slots(pk: PreemptPacked) -> np.ndarray:
     consumed offsets no-ops), END1.  Phase 2: a single BURN slot per
     (queue, job) carrying job_ptask_end in col 2 — see the module
     docstring for why the under-request sweep reduces to a cursor burn."""
-    slots = []
-    for phase, j in pk.schedule:
-        s, e = int(pk.job_ptask_start[j]), int(pk.job_ptask_end[j])
-        if phase == 1:
-            slots.append((K_BEGIN1, j, 0, 0))
-            for p in range(s, e):
-                slots.append((K_ATT1, j, p, 0))
-            slots.append((K_END1, j, 0, 0))
-        else:
-            slots.append((K_BURN2, j, e, 0))
-    if not slots:
+    if pk.schedule.shape[0] == 0:
         return np.zeros((0, 4), np.int32)
-    return np.array(slots, dtype=np.int32)
+    phases = pk.schedule[:, 0].astype(np.int64)
+    jrows = pk.schedule[:, 1].astype(np.int64)
+    starts = pk.job_ptask_start[jrows].astype(np.int64)
+    ends = pk.job_ptask_end[jrows].astype(np.int64)
+    ntasks = np.maximum(ends - starts, 0)
+    # slots per schedule row: phase 1 → BEGIN + tasks + END; phase 2 → 1
+    row_slots = np.where(phases == 1, ntasks + 2, 1)
+    offsets = np.concatenate([[0], np.cumsum(row_slots)])
+    S = int(offsets[-1])
+    out = np.zeros((S, 4), dtype=np.int32)
+
+    p1 = phases == 1
+    out[offsets[:-1][p1], 0] = K_BEGIN1
+    out[offsets[:-1][p1], 1] = jrows[p1]
+    end_pos = offsets[1:][p1] - 1
+    out[end_pos, 0] = K_END1
+    out[end_pos, 1] = jrows[p1]
+    # ATT1 runs: for each phase-1 row, positions offset+1 .. offset+n
+    att_total = int(ntasks[p1].sum())
+    if att_total:
+        att_rows = np.repeat(np.flatnonzero(p1), ntasks[p1])
+        within = np.arange(att_total) - np.repeat(
+            np.concatenate([[0], np.cumsum(ntasks[p1])])[:-1], ntasks[p1]
+        )
+        att_pos = (offsets[:-1][p1].repeat(ntasks[p1]) + 1 + within).astype(np.int64)
+        out[att_pos, 0] = K_ATT1
+        out[att_pos, 1] = jrows[att_rows]
+        out[att_pos, 2] = (starts[att_rows] + within).astype(np.int32)
+    p2 = ~p1
+    out[offsets[:-1][p2], 0] = K_BURN2
+    out[offsets[:-1][p2], 1] = jrows[p2]
+    out[offsets[:-1][p2], 2] = ends[p2].astype(np.int32)
+    return out
 
 
 def prepare_preempt_arrays(pk: PreemptPacked) -> Tuple[dict, dict, np.ndarray]:
@@ -410,38 +466,55 @@ def prepare_preempt_arrays(pk: PreemptPacked) -> Tuple[dict, dict, np.ndarray]:
     NV = min(NK, base.node_idle.shape[0])
 
     # victim slots: k-th victim of each node, in eviction order (the
-    # order pack_preempt_session appended them)
+    # order pack_preempt_session appended them).  Fully vectorized —
+    # the Python per-victim loop was ~0.5s at 90k victims, dominating
+    # the whole device pass.
     V = pk.n_victims
-    per_node = np.zeros(NK, dtype=np.int64)
+    vnode = pk.vic_node[:V].astype(np.int64)
+    # slot index = position within the victim's node group, preserving
+    # input order (stable argsort of node, then rank within group)
+    order = np.argsort(vnode, kind="stable")
+    sorted_nodes = vnode[order]
+    group_start = np.zeros(V, dtype=np.int64)
+    if V:
+        new_grp = np.concatenate([[True], sorted_nodes[1:] != sorted_nodes[:-1]])
+        starts = np.flatnonzero(new_grp)
+        group_start = np.repeat(starts, np.diff(np.append(starts, V)))
     vic_slot = np.zeros(max(V, 1), dtype=np.int64)
-    for i in range(V):
-        n = int(pk.vic_node[i])
-        vic_slot[i] = per_node[n]
-        per_node[n] += 1
-    K = int(max(1, per_node.max(initial=1)))
+    if V:
+        vic_slot[order] = np.arange(V) - group_start
+    per_node_max = np.bincount(vnode, minlength=1).max(initial=0) if V else 0
+    K = int(max(1, per_node_max))
 
-    vr = np.zeros((R * K, NS, LANES), dtype=np.float32)
-    vjob = np.zeros((K, NS, LANES), dtype=np.int32)
-    vq = np.full((K, NS, LANES), -2, dtype=np.int32)
-    vjp = np.zeros((K, NS, LANES), dtype=np.int32)
-    vjmin = np.zeros((K, NS, LANES), dtype=np.float32)
-    galw0 = np.zeros((K, NS, LANES), dtype=np.float32)
-    alive0 = np.zeros((K, NS, LANES), dtype=np.float32)
-    for i in range(V):
-        n = int(pk.vic_node[i])
-        k = int(vic_slot[i])
-        sub, lane = n // LANES, n % LANES
-        jrow = int(pk.vic_job[i])
+    vr = np.zeros((R * K, NK), dtype=np.float32)
+    vjob = np.zeros((K, NK), dtype=np.int32)
+    vq = np.full((K, NK), -2, dtype=np.int32)
+    vjp = np.zeros((K, NK), dtype=np.int32)
+    vjmin = np.zeros((K, NK), dtype=np.float32)
+    galw0 = np.zeros((K, NK), dtype=np.float32)
+    alive0 = np.zeros((K, NK), dtype=np.float32)
+    if V:
+        ks = vic_slot[:V]
+        jrows = pk.vic_job[:V]
         for r in range(R):
-            vr[r * K + k, sub, lane] = pk.vic_resreq[i, r]
-        vjob[k, sub, lane] = jrow
-        vq[k, sub, lane] = pk.job_queue[jrow]
-        prio = int(np.clip(pk.job_prio[jrow], -(2**31), 2**31 - 1))
-        vjp[k, sub, lane] = prio
-        vjmin[k, sub, lane] = float(pk.job_min_avail[jrow])
-        alive0[k, sub, lane] = 1.0
-        ma, rd = int(pk.job_min_avail[jrow]), int(pk.job_ready0[jrow])
-        galw0[k, sub, lane] = 1.0 if (ma <= rd - 1 or ma == 1) else 0.0
+            vr[r * K + ks, vnode] = pk.vic_resreq[:V, r]
+        vjob[ks, vnode] = jrows
+        vq[ks, vnode] = pk.job_queue[jrows]
+        vjp[ks, vnode] = np.clip(
+            pk.job_prio[jrows], -(2**31), 2**31 - 1
+        ).astype(np.int32)
+        ma = pk.job_min_avail[jrows]
+        rd = pk.job_ready0[jrows]
+        vjmin[ks, vnode] = ma.astype(np.float32)
+        alive0[ks, vnode] = 1.0
+        galw0[ks, vnode] = ((ma <= rd - 1) | (ma == 1)).astype(np.float32)
+    vr = vr.reshape(R * K, NS, LANES)
+    vjob = vjob.reshape(K, NS, LANES)
+    vq = vq.reshape(K, NS, LANES)
+    vjp = vjp.reshape(K, NS, LANES)
+    vjmin = vjmin.reshape(K, NS, LANES)
+    galw0 = galw0.reshape(K, NS, LANES)
+    alive0 = alive0.reshape(K, NS, LANES)
 
     # class feasibility planes (same construction as the allocate kernel)
     task_cls, class_sel, class_tol = _feasibility_classes(base)
@@ -454,12 +527,32 @@ def prepare_preempt_arrays(pk: PreemptPacked) -> Tuple[dict, dict, np.ndarray]:
     cf[:, :NV] = sel_ok & tol_ok & base.node_ok[None, :NV]
 
     P_pad = -(-P // 8) * 8
-    ptask = np.zeros((P_pad, R + 1), dtype=np.float32)
+    ptask = np.zeros((P_pad, R + 2), dtype=np.float32)
     n_copy = min(P_pad, base.task_resreq.shape[0])
     ptask[:n_copy, :R] = base.task_resreq[:n_copy]
     ptask[: min(P_pad, task_cls.shape[0]), R] = task_cls[
         : min(P_pad, task_cls.shape[0])
     ].astype(np.float32)
+
+    # score classes: distinct resreq rows (node scores are static per
+    # pass, so one plane per distinct row is computed at kernel init).
+    # SC is bucketed to a power of two (bounds jit-cache churn on
+    # heterogeneous request mixes) and capped: past the cap the kernel
+    # scores inline (SC=0) instead of unrolling a huge init loop.
+    screq_rows, sc_inv = np.unique(
+        base.task_resreq[:P], axis=0, return_inverse=True
+    )
+    n_classes = screq_rows.shape[0]
+    if n_classes <= SCORE_CLASS_CAP:
+        SC = 8
+        while SC < n_classes:
+            SC *= 2
+        ptask[:P, R + 1] = sc_inv.astype(np.float32)
+    else:
+        SC = 0
+    screq = np.zeros((max(SC, 8), R), dtype=np.float32)
+    if SC:
+        screq[:n_classes] = screq_rows
 
     def planes(arr2d):  # [N_pad, R] → [R, NS, 128]
         wide = np.zeros((NK, R), dtype=np.float32)
@@ -497,49 +590,73 @@ def prepare_preempt_arrays(pk: PreemptPacked) -> Tuple[dict, dict, np.ndarray]:
     )
 
     PS = -(-P // LANES)
+    naux = np.stack(
+        [
+            _node_plane(base.node_task_count.astype(np.float32), NK),
+            _node_plane(base.node_max_tasks.astype(np.float32), NK),
+        ]
+    )
+    # Single stacked f32/i32 node-plane buffers: ONE host→device transfer
+    # each instead of ~14 (each transfer pays the device-link round trip;
+    # maxal/allocpos are derived on device from alloc).  Row layout:
+    #   f32: cf[C] | used[R] | alloc[R] | fi0[R] | naux[2] | vr[R*K]
+    #        | vjmin[K] | vinit[2K]
+    #   i32: vjob[K] | vq[K] | vjp[K]
+    fstack = np.concatenate(
+        [
+            np.ascontiguousarray(cf.reshape(C, NS, LANES)),
+            used,
+            alloc,
+            planes(pk.node_fi0),
+            naux,
+            vr,
+            vjmin,
+            np.concatenate([galw0, alive0]),
+        ]
+    )
+    istack = np.concatenate([vjob, vq, vjp])
     arrays = dict(
         tol=base.tolerance.reshape(1, R).astype(np.float32),
         ptask=ptask,
-        cf=np.ascontiguousarray(cf.reshape(C, NS, LANES)),
-        used=used,
-        alloc=alloc,
-        maxal=np.maximum(alloc, 1.0),
-        allocpos=(alloc > 0.0).astype(np.float32),
-        fi0=planes(pk.node_fi0),
-        naux=np.stack(
-            [
-                _node_plane(base.node_task_count.astype(np.float32), NK),
-                _node_plane(base.node_max_tasks.astype(np.float32), NK),
-            ]
-        ),
-        vr=vr,
-        vjob=vjob,
-        vq=vq,
-        vjp=vjp,
-        vjmin=vjmin,
-        vinit=np.concatenate([galw0, alive0]),
+        screq=screq,
+        fstack=fstack,
+        istack=istack,
+        jobsf=jobsf,
+        jobsi=jobsi,
     )
-    arrays["jobsf"] = jobsf
-    arrays["jobsi"] = jobsi
-    dims = dict(R=R, K=K, NS=NS, JS=JS, PS=PS, C=C, NK=NK)
+    dims = dict(R=R, K=K, NS=NS, JS=JS, PS=PS, C=C, NK=NK, SC=SC)
     return arrays, dims, vic_slot
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "R", "K", "NS", "JS", "PS", "SB", "weights", "interpret"
+        "R", "K", "C", "NS", "JS", "PS", "SB", "SC", "weights", "interpret"
     ),
 )
 def _preempt_call(
-    tol, sched, ptask, cf, used, alloc, maxal, allocpos, fi0, naux,
-    vr, vjob, vq, vjp, vjmin, vinit, jobsf, jobsi,
-    R, K, NS, JS, PS, SB, weights, interpret,
+    tol, sched, ptask, screq, fstack, istack, jobsf, jobsi,
+    R, K, C, NS, JS, PS, SB, SC, weights, interpret,
 ):
     S = sched.shape[0]
     G = S // SB
-    kernel = _make_preempt_kernel(R, K, NS, JS, PS, SB, weights)
-    C = cf.shape[0]
+    kernel = _make_preempt_kernel(R, K, NS, JS, PS, SB, SC, weights)
+
+    # device-side unpack of the stacked transfer buffers (XLA slices)
+    o = 0
+    cf = fstack[o : o + C]; o += C
+    used = fstack[o : o + R]; o += R
+    alloc = fstack[o : o + R]; o += R
+    fi0 = fstack[o : o + R]; o += R
+    naux = fstack[o : o + 2]; o += 2
+    vr = fstack[o : o + R * K]; o += R * K
+    vjmin = fstack[o : o + K]; o += K
+    vinit = fstack[o : o + 2 * K]; o += 2 * K
+    maxal = jnp.maximum(alloc, 1.0)
+    allocpos = (alloc > 0.0).astype(jnp.float32)
+    vjob = istack[0:K]
+    vq = istack[K : 2 * K]
+    vjp = istack[2 * K : 3 * K]
 
     full = lambda *shape: pl.BlockSpec(
         shape, lambda i: tuple(0 for _ in shape), memory_space=pltpu.VMEM
@@ -551,6 +668,7 @@ def _preempt_call(
             pl.BlockSpec((1, R), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((SB, 4), lambda i: (i, 0), memory_space=pltpu.VMEM),
             full(*ptask.shape),
+            full(*screq.shape),
             full(C, NS, LANES),
             full(R, NS, LANES),
             full(R, NS, LANES),
@@ -585,6 +703,7 @@ def _preempt_call(
             pltpu.VMEM((1, JS, LANES), jnp.float32),
             pltpu.VMEM((1, JS, LANES), jnp.int32),
             pltpu.VMEM((PS, LANES), jnp.int32),
+            pltpu.VMEM((screq.shape[0], NS, LANES), jnp.float32),
             pltpu.VMEM((R, NS, LANES), jnp.float32),
             pltpu.VMEM((1, NS, LANES), jnp.float32),
             pltpu.VMEM((K, NS, LANES), jnp.float32),
@@ -596,7 +715,7 @@ def _preempt_call(
         ],
         interpret=interpret,
     )(
-        tol, sched, ptask, cf, used, alloc, maxal, allocpos, fi0, naux,
+        tol, sched, ptask, screq, cf, used, alloc, maxal, allocpos, fi0, naux,
         vr, vjob, vq, vjp, vjmin, vinit, jobsf, jobsi,
     )
     return evicted, pipelined
@@ -619,11 +738,16 @@ def preempt_vmem_bytes(pk: PreemptPacked) -> int:
     PS = -(-P // LANES)
     task_cls, class_sel, _ = _feasibility_classes(base)
     C = class_sel.shape[0]
+    n_classes = np.unique(base.task_resreq[:P], axis=0).shape[0]
+    SC_pad = 8
+    while SC_pad < min(n_classes, SCORE_CLASS_CAP):
+        SC_pad *= 2
     plane = NK * 4
     n_planes = (
         C + 5 * R + 2  # cf + used/alloc/maxal/allocpos/fi0 + naux
         + R * K + 6 * K  # victim planes (vr, vjob/vq/vjp/vjmin, vinit×2)
         + (R + 1 + 3 * K) * 2  # node scratch + shadows
+        + SC_pad  # precomputed per-class score plane scratch (padded)
     )
     job_planes = (3 + 3 + 3 * 2) * JS * LANES * 4
     pipe = 2 * PS * LANES * 4
@@ -662,23 +786,14 @@ def run_preempt_pallas(
         jnp.asarray(arrays["tol"]),
         jnp.asarray(sched),
         jnp.asarray(arrays["ptask"]),
-        jnp.asarray(arrays["cf"]),
-        jnp.asarray(arrays["used"]),
-        jnp.asarray(arrays["alloc"]),
-        jnp.asarray(arrays["maxal"]),
-        jnp.asarray(arrays["allocpos"]),
-        jnp.asarray(arrays["fi0"]),
-        jnp.asarray(arrays["naux"]),
-        jnp.asarray(arrays["vr"]),
-        jnp.asarray(arrays["vjob"]),
-        jnp.asarray(arrays["vq"]),
-        jnp.asarray(arrays["vjp"]),
-        jnp.asarray(arrays["vjmin"]),
-        jnp.asarray(arrays["vinit"]),
+        jnp.asarray(arrays["screq"]),
+        jnp.asarray(arrays["fstack"]),
+        jnp.asarray(arrays["istack"]),
         jnp.asarray(arrays["jobsf"]),
         jnp.asarray(arrays["jobsi"]),
-        R=dims["R"], K=dims["K"], NS=dims["NS"], JS=dims["JS"],
-        PS=dims["PS"], SB=SB, weights=weights, interpret=interpret,
+        R=dims["R"], K=dims["K"], C=dims["C"], NS=dims["NS"], JS=dims["JS"],
+        PS=dims["PS"], SB=SB, SC=dims["SC"], weights=weights,
+        interpret=interpret,
     )
     ev_planes = np.asarray(ev_planes)
     pipe_flat = np.asarray(pipe_planes).reshape(-1)

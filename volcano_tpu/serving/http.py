@@ -40,6 +40,23 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             body = self.server.registry.render().encode()
             ctype = "text/plain; version=0.0.4"
+        elif self.path == "/debug/stacks":
+            # the pprof-goroutine analogue (cmd/scheduler/main.go:25
+            # imports net/http/pprof): live thread stacks for hang
+            # forensics
+            import sys
+            import threading
+            import traceback
+
+            frames = sys._current_frames()
+            parts = []
+            for t in threading.enumerate():
+                frame = frames.get(t.ident)
+                parts.append(f"--- {t.name} (daemon={t.daemon}) ---")
+                if frame is not None:
+                    parts.append("".join(traceback.format_stack(frame)))
+            body = "\n".join(parts).encode()
+            ctype = "text/plain"
         else:
             self.send_response(404)
             self.end_headers()
