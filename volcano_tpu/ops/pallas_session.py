@@ -359,6 +359,47 @@ def schedule_session_pallas(
     return jnp.where(committed, chosen, -1)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("weights", "block_size", "gang_rounds", "interpret"),
+)
+def schedule_session_pallas_packed(
+    taskrow_ext: jnp.ndarray,  # [T_act, R+3] — resreq, class, active0, job
+    cf_u8: jnp.ndarray,  # [C, NS, 128] u8
+    nd: jnp.ndarray,  # [3R+2, NS, 128]
+    tol: jnp.ndarray,  # [1, R]
+    jobs2: jnp.ndarray,  # [2, J_pad] i32 — min_available | ready_count
+    weights: ScoreWeights = DEFAULT_WEIGHTS,
+    block_size: int = 256,
+    gang_rounds: int = 3,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Transfer-packed entry: the per-task job row and initial active
+    mask ride inside the task rows and the two job vectors ride one
+    buffer, so a session ships FIVE host→device transfers instead of
+    eight — each extra transfer pays the device-link round trip.
+    Semantics identical to schedule_session_pallas (device-side
+    unpack + delegation)."""
+    R = taskrow_ext.shape[1] - 3
+    taskrow = taskrow_ext[:, : R + 2]
+    active0 = taskrow_ext[:, R + 1] > 0.0
+    task_job = taskrow_ext[:, R + 2].astype(jnp.int32)
+    return schedule_session_pallas(
+        taskrow,
+        cf_u8,
+        nd,
+        tol,
+        task_job,
+        jobs2[0],
+        jobs2[1],
+        active0,
+        weights=weights,
+        block_size=block_size,
+        gang_rounds=gang_rounds,
+        interpret=interpret,
+    )
+
+
 def _node_planes(arr: np.ndarray, NK: int) -> np.ndarray:
     """[N_pad, R] → [R, NS, 128] f32 planes over the first NK nodes
     (zero-padded when the snapshot's node pad is narrower than NK)."""
@@ -468,21 +509,28 @@ def run_packed_pallas(
 
     arrays, T_act, _ = prepare_pallas_arrays(snap, block_size)
 
-    active0 = np.zeros(T_act, dtype=bool)
-    active0[: min(snap.n_tasks, T_act)] = True
-    task_job = np.zeros(T_act, dtype=np.int32)
+    # active0 + task_job ride inside the task rows (f32 int-exact: job
+    # rows stay far below 2^24) — see schedule_session_pallas_packed.
+    T_rows = arrays["taskrow"].shape[0]
+    taskrow_ext = np.zeros((T_rows, arrays["taskrow"].shape[1] + 1), np.float32)
+    taskrow_ext[:, :-1] = arrays["taskrow"]
+    n_act = min(snap.n_tasks, T_act)
+    taskrow_ext[:n_act, -2] = 1.0  # active column
     n_tj = min(T_act, snap.task_job.shape[0])
-    task_job[:n_tj] = snap.task_job[:n_tj]
+    taskrow_ext[:n_tj, -1] = snap.task_job[:n_tj].astype(np.float32)
+    jobs2 = np.stack(
+        [
+            snap.job_min_available.astype(np.int32),
+            snap.job_ready_count.astype(np.int32),
+        ]
+    )
 
-    out = schedule_session_pallas(
-        jnp.asarray(arrays["taskrow"]),
+    out = schedule_session_pallas_packed(
+        jnp.asarray(taskrow_ext),
         jnp.asarray(arrays["cf_u8"]),
         jnp.asarray(arrays["nd"]),
         jnp.asarray(arrays["tol"]),
-        jnp.asarray(task_job),
-        jnp.asarray(snap.job_min_available.astype(np.int32)),
-        jnp.asarray(snap.job_ready_count.astype(np.int32)),
-        jnp.asarray(active0),
+        jnp.asarray(jobs2),
         weights=weights,
         block_size=block_size,
         gang_rounds=gang_rounds,

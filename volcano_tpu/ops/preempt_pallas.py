@@ -64,6 +64,18 @@ SCORE_CLASS_CAP = 64
 K_BEGIN1, K_ATT1, K_END1, K_BURN2, K_PAD = 0, 1, 2, 5, 9
 
 
+def _score_class_rows(pk: PreemptPacked):
+    """(distinct resreq rows, inverse) — memoized on the PreemptPacked
+    (both the VMEM gate and array prep need it, once per session)."""
+    cached = getattr(pk, "_score_class_cache", None)
+    if cached is not None:
+        return cached
+    P = pk.base.n_tasks
+    rows, inv = np.unique(pk.base.task_resreq[:P], axis=0, return_inverse=True)
+    pk._score_class_cache = (rows, inv)
+    return rows, inv
+
+
 def _make_preempt_kernel(
     R: int, K: int, NS: int, JS: int, PS: int, SB: int, SC: int,
     weights: ScoreWeights,
@@ -475,13 +487,11 @@ def prepare_preempt_arrays(pk: PreemptPacked) -> Tuple[dict, dict, np.ndarray]:
     # input order (stable argsort of node, then rank within group)
     order = np.argsort(vnode, kind="stable")
     sorted_nodes = vnode[order]
-    group_start = np.zeros(V, dtype=np.int64)
+    vic_slot = np.zeros(max(V, 1), dtype=np.int64)
     if V:
         new_grp = np.concatenate([[True], sorted_nodes[1:] != sorted_nodes[:-1]])
         starts = np.flatnonzero(new_grp)
         group_start = np.repeat(starts, np.diff(np.append(starts, V)))
-    vic_slot = np.zeros(max(V, 1), dtype=np.int64)
-    if V:
         vic_slot[order] = np.arange(V) - group_start
     per_node_max = np.bincount(vnode, minlength=1).max(initial=0) if V else 0
     K = int(max(1, per_node_max))
@@ -539,9 +549,7 @@ def prepare_preempt_arrays(pk: PreemptPacked) -> Tuple[dict, dict, np.ndarray]:
     # SC is bucketed to a power of two (bounds jit-cache churn on
     # heterogeneous request mixes) and capped: past the cap the kernel
     # scores inline (SC=0) instead of unrolling a huge init loop.
-    screq_rows, sc_inv = np.unique(
-        base.task_resreq[:P], axis=0, return_inverse=True
-    )
+    screq_rows, sc_inv = _score_class_rows(pk)
     n_classes = screq_rows.shape[0]
     if n_classes <= SCORE_CLASS_CAP:
         SC = 8
@@ -738,10 +746,13 @@ def preempt_vmem_bytes(pk: PreemptPacked) -> int:
     PS = -(-P // LANES)
     task_cls, class_sel, _ = _feasibility_classes(base)
     C = class_sel.shape[0]
-    n_classes = np.unique(base.task_resreq[:P], axis=0).shape[0]
-    SC_pad = 8
-    while SC_pad < min(n_classes, SCORE_CLASS_CAP):
-        SC_pad *= 2
+    n_classes = _score_class_rows(pk)[0].shape[0]
+    if n_classes > SCORE_CLASS_CAP:
+        SC_pad = 8  # inline-score mode: only the dummy screq pad remains
+    else:
+        SC_pad = 8
+        while SC_pad < n_classes:
+            SC_pad *= 2
     plane = NK * 4
     n_planes = (
         C + 5 * R + 2  # cf + used/alloc/maxal/allocpos/fi0 + naux
