@@ -1,0 +1,144 @@
+"""Job state-machine table — the reference's job_state_test.go pattern
+(1,294 LoC of table-driven (state, action, status) → (operation, retain
+set, next phase) cases), driven directly against the state classes with
+stubbed SyncJob/KillJob."""
+
+from __future__ import annotations
+
+import pytest
+
+from volcano_tpu.apis import batch, core
+from volcano_tpu.controllers.apis import JobInfo
+from volcano_tpu.controllers.job import state as jobstate
+
+
+def _job_info(phase, min_available=2, replicas=3, max_retry=0,
+              retry_count=0, running=0, pending=0, succeeded=0, failed=0,
+              terminating=0):
+    job = batch.Job(
+        metadata=core.ObjectMeta(name="j", namespace="ns"),
+        spec=batch.JobSpec(
+            min_available=min_available,
+            max_retry=max_retry,
+            tasks=[batch.TaskSpec(name="t", replicas=replicas)],
+        ),
+    )
+    job.status.state.phase = phase
+    job.status.retry_count = retry_count
+    job.status.running = running
+    job.status.pending = pending
+    job.status.succeeded = succeeded
+    job.status.failed = failed
+    job.status.terminating = terminating
+    job.status.min_available = min_available
+    ji = JobInfo()
+    ji.job = job
+    return ji
+
+
+class Recorder:
+    """Stub SyncJob/KillJob; applies the status callback to the job's
+    own status so the table can assert the resulting phase."""
+
+    def __init__(self, monkeypatch):
+        self.ops = []
+        monkeypatch.setattr(jobstate, "SyncJob", self._sync)
+        monkeypatch.setattr(jobstate, "KillJob", self._kill)
+
+    def _sync(self, ji, fn):
+        changed = fn(ji.job.status) if fn else None
+        self.ops.append(("sync", None, changed))
+
+    def _kill(self, ji, retain, fn):
+        changed = fn(ji.job.status) if fn else None
+        self.ops.append(("kill", retain, changed))
+
+    @property
+    def last(self):
+        return self.ops[-1]
+
+
+SOFT = jobstate.POD_RETAIN_PHASE_SOFT
+NONE = jobstate.POD_RETAIN_PHASE_NONE
+
+# (start phase, status kwargs, action, expected op, expected retain,
+#  expected end phase) — the job_state_test.go table shape
+CASES = [
+    # Pending
+    (batch.JOB_PENDING, {}, batch.RESTART_JOB_ACTION, "kill", NONE, batch.JOB_RESTARTING),
+    (batch.JOB_PENDING, {}, batch.ABORT_JOB_ACTION, "kill", SOFT, batch.JOB_ABORTING),
+    (batch.JOB_PENDING, {}, batch.TERMINATE_JOB_ACTION, "kill", SOFT, batch.JOB_TERMINATING),
+    (batch.JOB_PENDING, {}, batch.COMPLETE_JOB_ACTION, "kill", SOFT, batch.JOB_COMPLETING),
+    (batch.JOB_PENDING, {"running": 0}, batch.SYNC_JOB_ACTION, "sync", None, batch.JOB_PENDING),
+    (batch.JOB_PENDING, {"running": 2}, batch.SYNC_JOB_ACTION, "sync", None, batch.JOB_RUNNING),
+    (batch.JOB_PENDING, {"succeeded": 1, "running": 1}, batch.SYNC_JOB_ACTION, "sync", None, batch.JOB_RUNNING),
+    # Running
+    (batch.JOB_RUNNING, {"running": 3}, batch.RESTART_JOB_ACTION, "kill", NONE, batch.JOB_RESTARTING),
+    (batch.JOB_RUNNING, {"running": 3}, batch.ABORT_JOB_ACTION, "kill", SOFT, batch.JOB_ABORTING),
+    (batch.JOB_RUNNING, {"running": 3}, batch.TERMINATE_JOB_ACTION, "kill", SOFT, batch.JOB_TERMINATING),
+    (batch.JOB_RUNNING, {"running": 3}, batch.COMPLETE_JOB_ACTION, "kill", SOFT, batch.JOB_COMPLETING),
+    (batch.JOB_RUNNING, {"running": 3}, batch.SYNC_JOB_ACTION, "sync", None, batch.JOB_RUNNING),
+    (batch.JOB_RUNNING, {"succeeded": 3}, batch.SYNC_JOB_ACTION, "sync", None, batch.JOB_COMPLETED),
+    (batch.JOB_RUNNING, {"succeeded": 2, "failed": 1}, batch.SYNC_JOB_ACTION, "sync", None, batch.JOB_COMPLETED),
+    # Restarting
+    (batch.JOB_RESTARTING, {"retry_count": 3}, batch.SYNC_JOB_ACTION, "kill", NONE, batch.JOB_FAILED),
+    (batch.JOB_RESTARTING, {"retry_count": 1, "terminating": 0}, batch.SYNC_JOB_ACTION, "kill", NONE, batch.JOB_PENDING),
+    (batch.JOB_RESTARTING, {"retry_count": 1, "terminating": 3}, batch.SYNC_JOB_ACTION, "kill", NONE, batch.JOB_RESTARTING),
+    # Aborting
+    (batch.JOB_ABORTING, {"running": 1}, batch.SYNC_JOB_ACTION, "kill", SOFT, batch.JOB_ABORTING),
+    (batch.JOB_ABORTING, {}, batch.SYNC_JOB_ACTION, "kill", SOFT, batch.JOB_ABORTED),
+    (batch.JOB_ABORTING, {}, batch.RESUME_JOB_ACTION, "kill", SOFT, batch.JOB_RESTARTING),
+    # Aborted
+    (batch.JOB_ABORTED, {}, batch.RESUME_JOB_ACTION, "kill", SOFT, batch.JOB_RESTARTING),
+    (batch.JOB_ABORTED, {}, batch.SYNC_JOB_ACTION, "kill", SOFT, batch.JOB_ABORTED),
+    # Terminating
+    (batch.JOB_TERMINATING, {"terminating": 2}, batch.SYNC_JOB_ACTION, "kill", SOFT, batch.JOB_TERMINATING),
+    (batch.JOB_TERMINATING, {}, batch.SYNC_JOB_ACTION, "kill", SOFT, batch.JOB_TERMINATED),
+    # Completing
+    (batch.JOB_COMPLETING, {"pending": 1}, batch.SYNC_JOB_ACTION, "kill", SOFT, batch.JOB_COMPLETING),
+    (batch.JOB_COMPLETING, {}, batch.SYNC_JOB_ACTION, "kill", SOFT, batch.JOB_COMPLETED),
+    # Finished states: always re-kill with soft retain, phase untouched
+    (batch.JOB_COMPLETED, {}, batch.SYNC_JOB_ACTION, "kill", SOFT, batch.JOB_COMPLETED),
+    (batch.JOB_TERMINATED, {}, batch.SYNC_JOB_ACTION, "kill", SOFT, batch.JOB_TERMINATED),
+    (batch.JOB_FAILED, {}, batch.SYNC_JOB_ACTION, "kill", SOFT, batch.JOB_FAILED),
+]
+
+
+@pytest.mark.parametrize(
+    "phase,status_kw,action,op,retain,end_phase", CASES,
+    ids=[f"{c[0]}-{c[2]}-{i}" for i, c in enumerate(CASES)],
+)
+def test_state_action_table(monkeypatch, phase, status_kw, action, op,
+                            retain, end_phase):
+    rec = Recorder(monkeypatch)
+    ji = _job_info(phase, **status_kw)
+    jobstate.new_state(ji).execute(action)
+    got_op, got_retain, _ = rec.last
+    assert got_op == op
+    if retain is not None:
+        assert got_retain == retain
+    assert ji.job.status.state.phase == end_phase
+
+
+def test_restart_bumps_retry_count(monkeypatch):
+    rec = Recorder(monkeypatch)
+    ji = _job_info(batch.JOB_RUNNING, running=3)
+    jobstate.new_state(ji).execute(batch.RESTART_JOB_ACTION)
+    assert ji.job.status.retry_count == 1
+
+
+def test_restarting_respects_custom_max_retry(monkeypatch):
+    rec = Recorder(monkeypatch)
+    ji = _job_info(batch.JOB_RESTARTING, max_retry=5, retry_count=4)
+    jobstate.new_state(ji).execute(batch.SYNC_JOB_ACTION)
+    assert ji.job.status.state.phase == batch.JOB_PENDING  # 4 < 5
+    ji = _job_info(batch.JOB_RESTARTING, max_retry=5, retry_count=5)
+    jobstate.new_state(ji).execute(batch.SYNC_JOB_ACTION)
+    assert ji.job.status.state.phase == batch.JOB_FAILED
+
+
+def test_unknown_phase_defaults_to_pending(monkeypatch):
+    rec = Recorder(monkeypatch)
+    ji = _job_info("SomethingNew")
+    st = jobstate.new_state(ji)
+    assert isinstance(st, jobstate.PendingState)
