@@ -94,8 +94,16 @@ def bench_config(name: str, kwargs: dict, iters: int = 3) -> dict:
     # relay floor is reported alongside (compute_ms) for interpretation.
     e2e_s = _time(lambda: run_packed(snap), warmup=1, iters=iters)
     # The native host executor never touches the device — no relay floor
-    # to subtract from its sessions.
-    compute_s = e2e_s if executor == "native" else max(e2e_s - relay_s, 1e-9)
+    # to subtract from its sessions.  The floor is measured moments apart
+    # from the session through a jittery link: when it comes out ABOVE
+    # the session e2e, the compute estimate is unmeasurable this run —
+    # report null rather than a clamped 0 / exploded ratio.
+    if executor == "native":
+        compute_s = e2e_s
+    elif relay_s < e2e_s:
+        compute_s = e2e_s - relay_s
+    else:
+        compute_s = None
     device_assign = run_packed(snap)
 
     # Native baseline — best of 1-thread and 16-thread (the pooled sweep
@@ -124,10 +132,10 @@ def bench_config(name: str, kwargs: dict, iters: int = 3) -> dict:
         if baseline_s == baseline_s
         else None,
         "baseline_ms": round(baseline_s * 1e3, 3) if baseline_s == baseline_s else None,
-        "compute_ms": round(compute_s * 1e3, 3),
+        "compute_ms": round(compute_s * 1e3, 3) if compute_s is not None else None,
         "relay_floor_ms": round(relay_s * 1e3, 3),
         "vs_baseline_compute": round(baseline_s / compute_s, 2)
-        if baseline_s == baseline_s
+        if baseline_s == baseline_s and compute_s
         else None,
         "pods_per_sec": round(placed / e2e_s),
         "executor": executor,
@@ -167,7 +175,12 @@ def bench_preempt_config(name: str, kwargs: dict, iters: int = 3) -> dict:
     else:
         run = lambda: preempt_dense(pk)
     e2e_s, (dev_ev, dev_pipe) = _time_r(run, warmup=1, iters=iters)
-    compute_s = e2e_s if executor == "dense" else max(e2e_s - relay_s, 1e-9)
+    if executor == "dense":
+        compute_s = e2e_s
+    elif relay_s < e2e_s:
+        compute_s = e2e_s - relay_s
+    else:
+        compute_s = None  # floor measurement exceeded e2e (link jitter)
 
     base_iters = 1
     try:
@@ -196,10 +209,10 @@ def bench_preempt_config(name: str, kwargs: dict, iters: int = 3) -> dict:
         if baseline_s == baseline_s
         else None,
         "baseline_ms": round(baseline_s * 1e3, 3) if baseline_s == baseline_s else None,
-        "compute_ms": round(compute_s * 1e3, 3),
+        "compute_ms": round(compute_s * 1e3, 3) if compute_s is not None else None,
         "relay_floor_ms": round(relay_s * 1e3, 3),
         "vs_baseline_compute": round(baseline_s / compute_s, 2)
-        if baseline_s == baseline_s
+        if baseline_s == baseline_s and compute_s
         else None,
         "pods_per_sec": round(placed / e2e_s),
         "executor": executor,
