@@ -9,6 +9,7 @@ from volcano_tpu.actions import (
     enqueue,
     jax_allocate,
     jax_preempt,
+    jax_reclaim,
     preempt,
     reclaim,
 )
@@ -22,6 +23,7 @@ def register_all() -> None:
     register_action(reclaim.new())
     register_action(jax_allocate.new())
     register_action(jax_preempt.new())
+    register_action(jax_reclaim.new())
 
 
 register_all()

@@ -1,0 +1,80 @@
+"""jax-reclaim — the reclaim action with the victim-selection replay on
+the tensorized formulation.
+
+Reference behavior: pkg/scheduler/actions/reclaim/reclaim.go:42-202.
+Design mirrors actions/jax_preempt.py: the host packs the session
+(ops/reclaim_pack.pack_reclaim_session), the dense replay decides the
+whole pass (``reclaim_dense`` — vectorized victim eligibility/summing
+per node attempt, proven ≡ the host ReclaimAction in
+tests/test_reclaim_kernel.py), and the result applies through a real
+Statement so plugin event handlers and cache eviction stay intact.
+
+Any validation failure discards the bulk statement and falls back to
+the pure host ReclaimAction — semantics never degrade below the host
+path.  (Unlike preempt, reclaim never checks node resource fit — only
+the predicate set — so apply validates predicates alone,
+reclaim.go:123-126.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from volcano_tpu.actions.reclaim import ReclaimAction
+from volcano_tpu.api import FitError, TaskStatus
+from volcano_tpu.framework.interface import Action
+from volcano_tpu.framework.session import Session
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class JaxReclaimAction(Action):
+    def name(self) -> str:
+        return "jax-reclaim"
+
+    def execute(self, ssn: Session) -> None:
+        from volcano_tpu.ops.reclaim_pack import pack_reclaim_session, reclaim_dense
+
+        try:
+            pk = pack_reclaim_session(ssn)
+        except ValueError as e:
+            log.info("reclaim pack refused (%s); host fallback", e)
+            ReclaimAction().execute(ssn)
+            return
+        if pk.base.n_tasks == 0:
+            return
+        if pk.base.needs_host_validation:
+            ReclaimAction().execute(ssn)
+            return
+
+        evicted, pipelined = reclaim_dense(pk)
+        if not evicted.any() and not (pipelined >= 0).any():
+            return
+
+        stmt = ssn.statement()
+        try:
+            for i in np.nonzero(evicted)[0]:
+                job = ssn.jobs.get(pk.job_uids[pk.vic_job[i]])
+                task = job.tasks.get(pk.vic_uids[i]) if job else None
+                if task is None or task.status != TaskStatus.Running:
+                    raise FitError(task, None, "victim vanished")
+                stmt.evict(task, "reclaim")
+            for p in np.nonzero(pipelined >= 0)[0]:
+                node = ssn.nodes.get(pk.node_names[pipelined[p]])
+                job = ssn.jobs.get(pk.job_uids[pk.base.task_job[p]])
+                task = job.tasks.get(pk.ptask_uids[p]) if job else None
+                if task is None or node is None:
+                    raise FitError(task, node, "reclaimer vanished")
+                ssn.predicate_fn(task, node)  # raises FitError on veto
+                stmt.pipeline(task, node.name)
+        except FitError as e:
+            log.error("dense reclaim apply diverged (%s); host fallback", e)
+            stmt.discard()
+            ReclaimAction().execute(ssn)
+            return
+        stmt.commit()
+
+
+def new() -> JaxReclaimAction:
+    return JaxReclaimAction()
