@@ -591,3 +591,126 @@ class TestE2EErrorHandlingMatrix:
         # task policy (RestartJob) applied, not the job-level AbortJob
         assert got.status.state.phase == batch.JOB_RUNNING
         assert got.status.retry_count >= 1
+
+
+class TestE2EDistributedWorkloads:
+    """The reference's real-workload e2e suites (test/e2e/mpi.go,
+    tensorflow.go): multi-task gang jobs with the ssh/svc/env plugin
+    set, master/worker topology, stable FQDNs, full lifecycle."""
+
+    def _submit_distributed(self, cluster, name, master_replicas=1,
+                            worker_replicas=3, plugins=None):
+        def task(task_name, replicas, cmd):
+            return batch.TaskSpec(
+                name=task_name,
+                replicas=replicas,
+                template=core.PodTemplateSpec(
+                    spec=core.PodSpec(
+                        containers=[core.Container(
+                            name="main",
+                            command=cmd,
+                            resources={"requests": {"cpu": "1", "memory": "1Gi"}},
+                        )]
+                    )
+                ),
+            )
+
+        job = batch.Job(
+            metadata=core.ObjectMeta(name=name, namespace="default"),
+            spec=batch.JobSpec(
+                min_available=master_replicas + worker_replicas,
+                plugins=plugins or {"ssh": [], "svc": [], "env": []},
+                tasks=[
+                    task("mpimaster", master_replicas, ["mpiexec", "--hostfile",
+                                                       "/etc/volcano/mpiworker.host"]),
+                    task("mpiworker", worker_replicas, ["sshd", "-D"]),
+                ],
+            ),
+        )
+        return cluster.vc.create_job(job)
+
+    def test_mpi_style_job_runs_with_stable_fqdns(self):
+        """mpi.go: master + workers gang-scheduled, ssh keys shared,
+        hostfile ConfigMap carries every worker's stable FQDN."""
+        cluster = Cluster(nodes=4)
+        self._submit_distributed(cluster, "lm-mpi-job")
+        cluster.tick()
+
+        job = cluster.vc.get_job("default", "lm-mpi-job")
+        assert job.status.state.phase == batch.JOB_RUNNING
+        assert job.status.running == 4
+
+        # gang: ALL pods bound (no partial MPI ring)
+        pods = cluster.kube.list_pods("default")
+        assert len(pods) == 4 and all(p.spec.node_name for p in pods)
+
+        # svc: headless service + hosts configmap with worker FQDNs
+        svc = cluster.kube.get_service("default", "lm-mpi-job")
+        assert svc is not None and svc.spec.cluster_ip == "None"
+        cm = cluster.kube.get_config_map("default", "lm-mpi-job-svc")
+        hosts = cm.data["VC_TASK_HOSTS"]
+        for i in range(3):
+            assert f"lm-mpi-job-mpiworker-{i}.lm-mpi-job" in hosts
+
+        # ssh: one job-keyed RSA keypair mounted everywhere
+        secret = cluster.kube.get_secret("default", "lm-mpi-job-ssh")
+        assert secret is not None and "id_rsa" in secret.data
+        for p in pods:
+            mounts = [m.mount_path for m in p.spec.containers[0].volume_mounts]
+            assert "/root/.ssh" in mounts and "/etc/volcano" in mounts
+            assert p.spec.subdomain == "lm-mpi-job"
+
+        # env: worker indices stable
+        for i in range(3):
+            pod = cluster.kube.get_pod("default", f"lm-mpi-job-mpiworker-{i}")
+            envs = {e.name: e.value for e in pod.spec.containers[0].env}
+            assert envs["VC_TASK_INDEX"] == str(i)
+
+    def test_tensorflow_style_job_completes_on_chief(self):
+        """tensorflow.go dist-mnist shape: ps + worker tasks; job
+        completes when all finish."""
+        cluster = Cluster(nodes=4)
+        job = batch.Job(
+            metadata=core.ObjectMeta(name="tf-dist", namespace="default"),
+            spec=batch.JobSpec(
+                min_available=4,
+                plugins={"svc": [], "env": []},
+                policies=[batch.LifecyclePolicy(
+                    event=batch.TASK_COMPLETED_EVENT,
+                    action=batch.COMPLETE_JOB_ACTION)],
+                tasks=[
+                    batch.TaskSpec(
+                        name="ps", replicas=2,
+                        template=core.PodTemplateSpec(spec=core.PodSpec(
+                            containers=[core.Container(
+                                resources={"requests": {"cpu": "1", "memory": "1Gi"}})])),
+                    ),
+                    batch.TaskSpec(
+                        name="worker", replicas=2,
+                        template=core.PodTemplateSpec(spec=core.PodSpec(
+                            containers=[core.Container(
+                                resources={"requests": {"cpu": "1", "memory": "1Gi"}})])),
+                    ),
+                ],
+            ),
+        )
+        cluster.vc.create_job(job)
+        cluster.tick()
+        assert cluster.vc.get_job("default", "tf-dist").status.state.phase == batch.JOB_RUNNING
+
+        # workers finish (dist-mnist completes) → TaskCompleted → CompleteJob
+        for i in range(2):
+            cluster.kubelet.finish("default", f"tf-dist-worker-{i}")
+        cluster.tick(rounds=5)
+        job = cluster.vc.get_job("default", "tf-dist")
+        assert job.status.state.phase == batch.JOB_COMPLETED
+
+    def test_gang_holds_back_partial_distributed_job(self):
+        """mpi.go gang case: a distributed job larger than the cluster
+        binds NOTHING (no partial ring)."""
+        cluster = Cluster(nodes=1, node_cpu="2")
+        self._submit_distributed(cluster, "big-mpi", worker_replicas=7)
+        cluster.tick()
+        pods = cluster.kube.list_pods("default")
+        assert all(not p.spec.node_name for p in pods)
+        assert cluster.vc.get_job("default", "big-mpi").status.state.phase == batch.JOB_PENDING
