@@ -34,6 +34,10 @@ _SMALL_AREA = 1_000_000
 #: for Mosaic's own buffers and the double-buffered grid pipeline.
 _VMEM_BUDGET = 96 * 1024 * 1024
 
+#: SMEM (scalar memory) budget — ~1 MiB on TPU; the preempt kernel's
+#: per-job scalar state must fit (large-J sessions fall back to dense).
+_SMEM_BUDGET = 768 * 1024
+
 
 def _tpu_available() -> bool:
     try:
@@ -75,9 +79,15 @@ def select_preempt_executor(pk) -> str:
     if area < _SMALL_AREA:
         return "dense"
     if f32_lr_exact(base) and _tpu_available():
-        from volcano_tpu.ops.preempt_pallas import preempt_vmem_bytes
+        from volcano_tpu.ops.preempt_pallas import (
+            preempt_smem_bytes,
+            preempt_vmem_bytes,
+        )
 
-        if preempt_vmem_bytes(pk) <= _VMEM_BUDGET:
+        if (
+            preempt_vmem_bytes(pk) <= _VMEM_BUDGET
+            and preempt_smem_bytes(pk) <= _SMEM_BUDGET
+        ):
             return "pallas"
     return "dense"
 

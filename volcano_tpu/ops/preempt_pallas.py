@@ -91,7 +91,10 @@ def _make_preempt_kernel(
 
     def kernel(
         tol_ref,  # SMEM [1, R]
-        sched_ref,  # VMEM [SB, 4] i32 (grid-streamed): kind, job, task, pad
+        sched_ref,  # SMEM [SB*4] i32 (grid-streamed, flat): slot s is
+        #           (kind, job, task, pad) at s*4 — SMEM so slot headers
+        #           are scalar reads, not one-hot plane reductions, and
+        #           1-D so the window isn't lane-padded to 128
         ptask_ref,  # VMEM [P_pad, R+2] f32 — resreq lanes, feas class, score class
         screq_ref,  # VMEM [SC_pad, R] f32 — distinct resreq rows
         cf_ref,  # VMEM [C, NS, 128] f32 class feasibility (incl. node_ok)
@@ -107,8 +110,9 @@ def _make_preempt_kernel(
         vjp_ref,  # VMEM [K, NS, 128] i32 — victim job priority
         vjmin_ref,  # VMEM [K, NS, 128] f32 — victim job min_available
         vinit_ref,  # VMEM [2*K, NS, 128] f32 — galw0 | alive0
-        jobsf_ref,  # VMEM [3, JS, 128] f32 — ready0, waiting0, min_avail
-        jobsi_ref,  # VMEM [3, JS, 128] i32 — cursor0, jqueue, jprio
+        jobsf_ref,  # VMEM [2, JS, 128] f32 — ready0, waiting0
+        jobsmem_ref,  # SMEM [3*JPAD] i32 — cursor0 | jqueue | jprio (flat)
+        minav_ref,  # SMEM [JPAD] f32 — min_available as scalars
         evicted_out,  # out VMEM [K, NS, 128] i32
         pipelined_out,  # out VMEM [PS, 128] i32
         fi_s,  # scratch [R, NS, 128] f32
@@ -118,7 +122,8 @@ def _make_preempt_kernel(
         evic_s,  # scratch [K, NS, 128] i32
         ready_s,  # scratch [1, JS, 128] f32
         wait_s,  # scratch [1, JS, 128] f32
-        cursor_s,  # scratch [1, JS, 128] i32
+        cursor_s,  # SMEM scratch [JPAD] i32 — rollback-exempt, so pure
+        #           scalar state (the host PQ pops have no undo)
         pipe_s,  # scratch [PS, 128] i32
         spre_s,  # scratch [SC_pad, NS, 128] f32 — per-class score planes
         fi_sh,  # shadow [R, NS, 128]
@@ -142,7 +147,12 @@ def _make_preempt_kernel(
             evic_s[:] = jnp.zeros((K, NS, LANES), jnp.int32)
             ready_s[:] = jobsf_ref[0:1]
             wait_s[:] = jobsf_ref[1:2]
-            cursor_s[:] = jobsi_ref[0:1]
+
+            def _cp(k, _):
+                cursor_s[k] = jobsmem_ref[k]
+                return 0
+
+            jax.lax.fori_loop(0, JS * LANES, _cp, 0)
             pipe_s[:] = jnp.full((PS, LANES), -1, jnp.int32)
             # precompute the static per-class score planes
             if SC:
@@ -178,25 +188,25 @@ def _make_preempt_kernel(
             + jax.lax.broadcasted_iota(jnp.int32, (PS, LANES), 1)
         )
         row_lane = jax.lax.broadcasted_iota(jnp.int32, (1, R + 2), 1)
-        row4 = jax.lax.broadcasted_iota(jnp.int32, (1, 4), 1)
 
-        # scalar reads from the job planes (one-hot sum — no SMEM scalar
-        # loads, same trick as the allocate kernel's task rows)
+        # mutable job counters (ready/wait) live as VMEM planes (they are
+        # shadow-copied on statement rollback); reads are one-hot sums.
+        # STATIC job metadata and the rollback-exempt cursor live in SMEM
+        # and are plain scalar loads.
         def jread_f(plane, j):
             return jnp.sum(jnp.where(jidx == j, plane, 0.0))
 
-        def jread_i(plane, j):
-            return jnp.sum(jnp.where(jidx == j, plane, 0))
+        JPAD = JS * LANES
 
         def jqueue_of(j):
-            return jread_i(jobsi_ref[1], j)
+            return jobsmem_ref[JPAD + j]
 
         def jprio_of(j):
-            return jread_i(jobsi_ref[2], j)
+            return jobsmem_ref[2 * JPAD + j]
 
         def pipelined_job(j):
-            return jread_f(wait_s[0], j) + jread_f(ready_s[0], j) >= jread_f(
-                jobsf_ref[2], j
+            return (
+                jread_f(wait_s[0], j) + jread_f(ready_s[0], j) >= minav_ref[j]
             )
 
         def save_shadow():
@@ -360,14 +370,9 @@ def _make_preempt_kernel(
 
         # ---- schedule slot loop ----
         def slot(s, _):
-            srow = sched_ref[pl.ds(s, 1), :]  # [1, 4]
-
-            def scol(c):
-                return jnp.sum(jnp.where(row4 == c, srow, 0))
-
-            kind = scol(0)
-            j = scol(1)
-            p = scol(2)
+            kind = sched_ref[s * 4 + 0]
+            j = sched_ref[s * 4 + 1]
+            p = sched_ref[s * 4 + 2]
 
             @pl.when(kind == K_BEGIN1)
             def _():
@@ -375,12 +380,12 @@ def _make_preempt_kernel(
 
             @pl.when(kind == K_ATT1)
             def _():
-                cur = jread_i(cursor_s[0], j)
+                cur = cursor_s[j]
                 fire = (cur == p) & ~pipelined_job(j)
 
                 @pl.when(fire)
                 def _():
-                    cursor_s[0] = cursor_s[0] + jnp.where(jidx == j, 1, 0)
+                    cursor_s[j] = cur + 1
                     attempt(j, p, inter=True)
 
             @pl.when(kind == K_END1)
@@ -395,11 +400,11 @@ def _make_preempt_kernel(
                 # any remain (see module docstring — the attempt itself
                 # provably fails under the supported tier, so only the
                 # cursor moves).  Slot col 2 carries job_ptask_end.
-                cur = jread_i(cursor_s[0], j)
+                cur = cursor_s[j]
 
                 @pl.when(cur < p)
                 def _():
-                    cursor_s[0] = cursor_s[0] + jnp.where(jidx == j, 1, 0)
+                    cursor_s[j] = cur + 1
 
             return 0
 
@@ -574,28 +579,31 @@ def prepare_preempt_arrays(pk: PreemptPacked) -> Tuple[dict, dict, np.ndarray]:
     J = max(pk.n_jobs, 1)
     JS = -(-J // LANES)
 
-    def jplane(vals, dtype):
+    def jflat(vals, dtype):
         out = np.zeros(JS * LANES, dtype=dtype)
         out[: vals.shape[0]] = vals
-        return out.reshape(JS, LANES)
+        return out
+
+    def jplane(vals, dtype):
+        return jflat(vals, dtype).reshape(JS, LANES)
 
     jobsf = np.stack(
         [
             jplane(pk.job_ready0.astype(np.float32), np.float32),
             jplane(pk.job_waiting0.astype(np.float32), np.float32),
-            jplane(pk.job_min_avail.astype(np.float32), np.float32),
         ]
     )
-    jobsi = np.stack(
+    jobsmem = np.concatenate(
         [
-            jplane(pk.job_ptask_start.astype(np.int32), np.int32),
-            jplane(pk.job_queue.astype(np.int32), np.int32),
-            jplane(
+            jflat(pk.job_ptask_start.astype(np.int32), np.int32),
+            jflat(pk.job_queue.astype(np.int32), np.int32),
+            jflat(
                 np.clip(pk.job_prio, -(2**31), 2**31 - 1).astype(np.int32),
                 np.int32,
             ),
         ]
     )
+    minav = jflat(pk.job_min_avail.astype(np.float32), np.float32)
 
     PS = -(-P // LANES)
     naux = np.stack(
@@ -630,7 +638,8 @@ def prepare_preempt_arrays(pk: PreemptPacked) -> Tuple[dict, dict, np.ndarray]:
         fstack=fstack,
         istack=istack,
         jobsf=jobsf,
-        jobsi=jobsi,
+        jobsmem=jobsmem,
+        minav=minav,
     )
     dims = dict(R=R, K=K, NS=NS, JS=JS, PS=PS, C=C, NK=NK, SC=SC)
     return arrays, dims, vic_slot
@@ -643,10 +652,10 @@ def prepare_preempt_arrays(pk: PreemptPacked) -> Tuple[dict, dict, np.ndarray]:
     ),
 )
 def _preempt_call(
-    tol, sched, ptask, screq, fstack, istack, jobsf, jobsi,
+    tol, sched, ptask, screq, fstack, istack, jobsf, jobsmem, minav,
     R, K, C, NS, JS, PS, SB, SC, weights, interpret,
 ):
-    S = sched.shape[0]
+    S = sched.shape[0] // 4  # sched arrives flat [S_pad*4]
     G = S // SB
     kernel = _make_preempt_kernel(R, K, NS, JS, PS, SB, SC, weights)
 
@@ -674,7 +683,7 @@ def _preempt_call(
         grid=(G,),
         in_specs=[
             pl.BlockSpec((1, R), lambda i: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((SB, 4), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((SB * 4,), lambda i: (i,), memory_space=pltpu.SMEM),
             full(*ptask.shape),
             full(*screq.shape),
             full(C, NS, LANES),
@@ -690,8 +699,13 @@ def _preempt_call(
             full(K, NS, LANES),
             full(K, NS, LANES),
             full(2 * K, NS, LANES),
-            full(3, JS, LANES),
-            full(3, JS, LANES),
+            full(2, JS, LANES),
+            pl.BlockSpec(
+                (3 * JS * LANES,), lambda i: (0,), memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec(
+                (JS * LANES,), lambda i: (0,), memory_space=pltpu.SMEM
+            ),
         ],
         out_specs=[
             full(K, NS, LANES),
@@ -709,7 +723,7 @@ def _preempt_call(
             pltpu.VMEM((K, NS, LANES), jnp.int32),
             pltpu.VMEM((1, JS, LANES), jnp.float32),
             pltpu.VMEM((1, JS, LANES), jnp.float32),
-            pltpu.VMEM((1, JS, LANES), jnp.int32),
+            pltpu.SMEM((JS * LANES,), jnp.int32),
             pltpu.VMEM((PS, LANES), jnp.int32),
             pltpu.VMEM((screq.shape[0], NS, LANES), jnp.float32),
             pltpu.VMEM((R, NS, LANES), jnp.float32),
@@ -724,7 +738,7 @@ def _preempt_call(
         interpret=interpret,
     )(
         tol, sched, ptask, screq, cf, used, alloc, maxal, allocpos, fi0, naux,
-        vr, vjob, vq, vjp, vjmin, vinit, jobsf, jobsi,
+        vr, vjob, vq, vjp, vjmin, vinit, jobsf, jobsmem, minav,
     )
     return evicted, pipelined
 
@@ -760,10 +774,22 @@ def preempt_vmem_bytes(pk: PreemptPacked) -> int:
         + (R + 1 + 3 * K) * 2  # node scratch + shadows
         + SC_pad  # precomputed per-class score plane scratch (padded)
     )
-    job_planes = (3 + 3 + 3 * 2) * JS * LANES * 4
+    # jobsf (2 rows) + ready/wait scratch and shadows (4 rows of [1,JS,128])
+    job_planes = (2 + 4) * JS * LANES * 4
     pipe = 2 * PS * LANES * 4
     ptask = P * LANES * 4  # [P_pad, R+1] tiles to 128 lanes
     return n_planes * plane + job_planes + pipe + ptask + K * plane
+
+
+def preempt_smem_bytes(pk: PreemptPacked) -> int:
+    """Estimated SMEM footprint: the flat schedule block (double
+    buffered), job metadata scalars, cursor scratch, minav — TPU scalar
+    memory is ~1 MB, so large-J sessions must be gated separately from
+    VMEM (the dispatcher checks both)."""
+    J = max(pk.n_jobs, 1)
+    JPAD = -(-J // LANES) * LANES
+    sched_block = 1024 * 4 * 4 * 2  # SB slots × 4 cols × i32 × double buffer
+    return sched_block + (3 * JPAD + JPAD) * 4 + JPAD * 4
 
 
 def run_preempt_pallas(
@@ -792,6 +818,7 @@ def run_preempt_pallas(
     sched = np.full((S_pad, 4), 0, dtype=np.int32)
     sched[:, 0] = K_PAD
     sched[:S] = slots
+    sched = np.ascontiguousarray(sched.reshape(-1))  # flat for SMEM
 
     ev_planes, pipe_planes = _preempt_call(
         jnp.asarray(arrays["tol"]),
@@ -801,7 +828,8 @@ def run_preempt_pallas(
         jnp.asarray(arrays["fstack"]),
         jnp.asarray(arrays["istack"]),
         jnp.asarray(arrays["jobsf"]),
-        jnp.asarray(arrays["jobsi"]),
+        jnp.asarray(arrays["jobsmem"]),
+        jnp.asarray(arrays["minav"]),
         R=dims["R"], K=dims["K"], C=dims["C"], NS=dims["NS"], JS=dims["JS"],
         PS=dims["PS"], SB=SB, SC=dims["SC"], weights=weights,
         interpret=interpret,
