@@ -1,0 +1,130 @@
+"""Controller job-cache table — the reference's
+pkg/controllers/cache/cache_test.go pattern: add/update/delete job and
+pod interleavings, shell entries (pods before job), GC of drained
+shells, and TaskCompleted rollups."""
+
+from __future__ import annotations
+
+import pytest
+
+from volcano_tpu.apis import batch, core
+from volcano_tpu.controllers.cache import JobCache
+
+from tests.builders import build_pod
+
+
+def _job(name="j1", ns="ns"):
+    return batch.Job(
+        metadata=core.ObjectMeta(name=name, namespace=ns),
+        spec=batch.JobSpec(
+            min_available=1,
+            tasks=[batch.TaskSpec(name="worker", replicas=2)],
+        ),
+    )
+
+
+def _pod(name, job="j1", task="worker", phase="Pending", ns="ns"):
+    pod = build_pod(ns, name, "", {"cpu": "1", "memory": "1G"}, phase=phase)
+    pod.metadata.annotations[batch.JOB_NAME_KEY] = job
+    pod.metadata.annotations[batch.TASK_SPEC_KEY] = task
+    return pod
+
+
+class TestJobCacheLifecycle:
+    def test_add_get_clone_map_isolated(self):
+        """Reference Clone contract (apis/job_info.go:37-52): the pods
+        MAP is copied (mutations don't leak back) while the Job object
+        itself is shared by reference."""
+        cache = JobCache()
+        cache.add(_job())
+        cache.add_pod(_pod("j1-worker-0"))
+        info = cache.get("ns/j1")
+        assert info is not None and info.job.metadata.name == "j1"
+        info.pods["worker"].clear()
+        assert "j1-worker-0" in cache.get("ns/j1").pods["worker"]
+
+    def test_duplicate_add_rejected(self):
+        cache = JobCache()
+        cache.add(_job())
+        with pytest.raises(ValueError, match="duplicated job"):
+            cache.add(_job())
+
+    def test_pods_before_job_shell_entry(self):
+        """cache.go: pod events can arrive before the job object — a
+        shell entry accumulates them and the late Add fills the job."""
+        cache = JobCache()
+        cache.add_pod(_pod("j1-worker-0"))
+        info = cache.get("ns/j1")
+        assert info is not None and info.job is None
+        assert "j1-worker-0" in info.pods["worker"]
+        cache.add(_job())  # late add onto the shell: not a duplicate
+        info = cache.get("ns/j1")
+        assert info.job is not None
+        assert "j1-worker-0" in info.pods["worker"]
+
+    def test_delete_pod_gcs_drained_shell(self):
+        cache = JobCache()
+        pod = _pod("j1-worker-0")
+        cache.add_pod(pod)
+        cache.delete_pod(pod)
+        assert cache.get("ns/j1") is None  # shell drained → GC'd
+
+    def test_delete_pod_keeps_entry_with_job(self):
+        cache = JobCache()
+        cache.add(_job())
+        pod = _pod("j1-worker-0")
+        cache.add_pod(pod)
+        cache.delete_pod(pod)
+        info = cache.get("ns/j1")
+        assert info is not None and info.job is not None
+
+    def test_update_upserts(self):
+        cache = JobCache()
+        job = _job()
+        cache.update(job)  # update-before-add upserts (resync path)
+        assert cache.get("ns/j1") is not None
+        job2 = _job()
+        job2.spec.max_retry = 7
+        cache.update(job2)
+        assert cache.get("ns/j1").job.spec.max_retry == 7
+
+    def test_delete_job(self):
+        cache = JobCache()
+        cache.add(_job())
+        cache.delete(_job())
+        assert cache.get("ns/j1") is None
+
+
+class TestTaskCompleted:
+    def test_all_succeeded(self):
+        cache = JobCache()
+        cache.add(_job())
+        for i in range(2):
+            cache.add_pod(_pod(f"j1-worker-{i}", phase="Succeeded"))
+        assert cache.task_completed("ns/j1", "worker")
+
+    def test_partial_not_completed(self):
+        cache = JobCache()
+        cache.add(_job())
+        cache.add_pod(_pod("j1-worker-0", phase="Succeeded"))
+        cache.add_pod(_pod("j1-worker-1", phase="Running"))
+        assert not cache.task_completed("ns/j1", "worker")
+
+    def test_pod_phase_update_flips_completion(self):
+        cache = JobCache()
+        cache.add(_job())
+        p0 = _pod("j1-worker-0", phase="Succeeded")
+        p1 = _pod("j1-worker-1", phase="Running")
+        cache.add_pod(p0)
+        cache.add_pod(p1)
+        assert not cache.task_completed("ns/j1", "worker")
+        p1done = p1.clone()
+        p1done.status.phase = "Succeeded"
+        cache.update_pod(p1done)
+        assert cache.task_completed("ns/j1", "worker")
+
+    def test_unknown_job_or_empty_task(self):
+        cache = JobCache()
+        assert not cache.task_completed("ns/ghost", "worker")
+        cache.add(_job())
+        assert not cache.task_completed("ns/j1", "worker")  # no pods yet
