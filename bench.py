@@ -9,8 +9,9 @@ Prints ONE JSON line:
   {"metric": ..., "value": <device session ms>, "unit": "ms",
    "vs_baseline": <baseline_ms / device_ms>}  (>1 ⇒ faster than reference)
 
-Flags: --config NAME (default 50k_pods_10k_nodes_gang_predicates),
---quick (1k×100 smoke), --all (print a line per config, headline last).
+Flags: default runs ALL BASELINE configs (headline last on stdout, the
+rest on stderr); --config NAME runs one; --quick (1k×100 smoke);
+--check runs the formulation-equivalence gates and exits.
 """
 
 from __future__ import annotations
@@ -68,7 +69,7 @@ def _relay_floor_s(in_bytes: int = 0, out_elems: int = 1024) -> float:
     return float(np.median(times))
 
 
-def bench_config(name: str, kwargs: dict, iters: int = 3) -> dict:
+def bench_config(name: str, kwargs: dict, iters: int = 5) -> dict:
     from volcano_tpu.ops.dispatch import run_packed_auto as run_packed
     from volcano_tpu.ops.dispatch import select_executor
     from volcano_tpu.ops.synthetic import generate_snapshot
@@ -146,7 +147,7 @@ def bench_config(name: str, kwargs: dict, iters: int = 3) -> dict:
     }
 
 
-def bench_preempt_config(name: str, kwargs: dict, iters: int = 3) -> dict:
+def bench_preempt_config(name: str, kwargs: dict, iters: int = 5) -> dict:
     """BASELINE config 5: the preempt pass measured end-to-end — device
     preempt replay (ops/preempt_pallas, ≡ host PreemptAction) vs the
     native C++ greedy preempt baseline (the reference preempt.go
