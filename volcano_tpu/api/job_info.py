@@ -8,9 +8,17 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 from volcano_tpu.api.resource import Resource, empty_resource
-from volcano_tpu.api.types import TaskStatus, allocated_status
+from volcano_tpu.api.types import ALLOCATED_STATUSES, TaskStatus, allocated_status
 from volcano_tpu.api.unschedule_info import FitErrors
 from volcano_tpu.apis import core, scheduling
+
+#: status sets for the readiness rollups (job_info.go:346-398) — hot on
+#: every PriorityQueue compare, so plain set membership
+_READY_STATUSES = frozenset(ALLOCATED_STATUSES | {TaskStatus.Succeeded})
+_VALID_STATUSES = frozenset(
+    ALLOCATED_STATUSES
+    | {TaskStatus.Succeeded, TaskStatus.Pipelined, TaskStatus.Pending}
+)
 
 
 def _task_status_from_pod(pod: core.Pod) -> TaskStatus:
@@ -217,7 +225,7 @@ class JobInfo:
         return sum(
             len(tasks)
             for status, tasks in self.task_status_index.items()
-            if allocated_status(status) or status == TaskStatus.Succeeded
+            if status in _READY_STATUSES
         )
 
     def waiting_task_num(self) -> int:
@@ -227,8 +235,7 @@ class JobInfo:
         return sum(
             len(tasks)
             for status, tasks in self.task_status_index.items()
-            if allocated_status(status)
-            or status in (TaskStatus.Succeeded, TaskStatus.Pipelined, TaskStatus.Pending)
+            if status in _VALID_STATUSES
         )
 
     def ready(self) -> bool:

@@ -26,13 +26,16 @@ class TaskStatus(enum.IntFlag):
 
 #: Statuses whose resources are held on a node ("occupied").
 #: Reference: types.go AllocatedStatus (Bound/Binding/Running/Allocated).
-_ALLOCATED = (
-    TaskStatus.Bound | TaskStatus.Binding | TaskStatus.Running | TaskStatus.Allocated
+#: Frozenset membership instead of Flag arithmetic — enum ``__and__``
+#: dominated the scheduler's hot comparator path (ready_task_num is
+#: evaluated on every PriorityQueue compare).
+ALLOCATED_STATUSES = frozenset(
+    (TaskStatus.Bound, TaskStatus.Binding, TaskStatus.Running, TaskStatus.Allocated)
 )
 
 
 def allocated_status(status: TaskStatus) -> bool:
-    return bool(status & _ALLOCATED)
+    return status in ALLOCATED_STATUSES
 
 
 class NodePhase(enum.IntEnum):

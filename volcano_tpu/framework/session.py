@@ -72,6 +72,7 @@ class Session:
         self.batch_node_order_fns: Dict[str, BatchNodeOrderFn] = {}
         self.node_map_fns: Dict[str, NodeMapFn] = {}
         self.node_reduce_fns: Dict[str, NodeReduceFn] = {}
+        self._ordered_chains: Dict = {}
         self.preemptable_fns: Dict[str, EvictableFn] = {}
         self.reclaimable_fns: Dict[str, EvictableFn] = {}
         self.overused_fns: Dict[str, ValidateFn] = {}
@@ -84,15 +85,19 @@ class Session:
 
     def add_job_order_fn(self, name: str, fn: CompareFn) -> None:
         self.job_order_fns[name] = fn
+        self._ordered_chains.clear()
 
     def add_queue_order_fn(self, name: str, fn: CompareFn) -> None:
         self.queue_order_fns[name] = fn
+        self._ordered_chains.clear()
 
     def add_task_order_fn(self, name: str, fn: CompareFn) -> None:
         self.task_order_fns[name] = fn
+        self._ordered_chains.clear()
 
     def add_namespace_order_fn(self, name: str, fn: CompareFn) -> None:
         self.namespace_order_fns[name] = fn
+        self._ordered_chains.clear()
 
     def add_preemptable_fn(self, name: str, fn: EvictableFn) -> None:
         self.preemptable_fns[name] = fn
@@ -225,16 +230,23 @@ class Session:
     # ---- comparator dispatch ----
 
     def _ordered(self, fns: Dict[str, CompareFn], flag: str, l, r) -> int:
-        for tier in self.tiers:
-            for plugin in tier.plugins:
-                if not getattr(plugin, flag):
-                    continue
-                fn = fns.get(plugin.name)
-                if fn is None:
-                    continue
-                j = fn(l, r)
-                if j != 0:
-                    return j
+        # The tier walk is invariant after session open; flatten it once
+        # per flag (each flag maps 1:1 to a registry) — comparators run
+        # on every heap operation.  add_*_order_fn invalidates the cache,
+        # so late registrations (nothing does this today) stay correct.
+        chain = self._ordered_chains.get(flag)
+        if chain is None:
+            chain = [
+                fns[plugin.name]
+                for tier in self.tiers
+                for plugin in tier.plugins
+                if getattr(plugin, flag) and plugin.name in fns
+            ]
+            self._ordered_chains[flag] = chain
+        for fn in chain:
+            j = fn(l, r)
+            if j != 0:
+                return j
         return 0
 
     def job_order_fn(self, l: JobInfo, r: JobInfo) -> bool:
