@@ -6,7 +6,13 @@ tensors (SURVEY.md §7).
 """
 
 from volcano_tpu.ops.packing import BitRegistry, PackedSnapshot, pack_session
-from volcano_tpu.ops.dispatch import run_packed_auto
+from volcano_tpu.ops.dispatch import (
+    run_packed_auto,
+    select_executor,
+    select_preempt_executor,
+)
+from volcano_tpu.ops.preempt_pack import pack_preempt_session, preempt_dense
+from volcano_tpu.ops.reclaim_pack import pack_reclaim_session, reclaim_dense
 from volcano_tpu.ops.kernels import (
     DEFAULT_WEIGHTS,
     ScoreWeights,
@@ -33,4 +39,10 @@ __all__ = [
     "run_packed",
     "run_packed_auto",
     "schedule_session",
+    "select_executor",
+    "select_preempt_executor",
+    "pack_preempt_session",
+    "preempt_dense",
+    "pack_reclaim_session",
+    "reclaim_dense",
 ]
