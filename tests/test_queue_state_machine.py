@@ -1,0 +1,112 @@
+"""Queue state-machine table — the reference's queue/state/*.go +
+queue_controller_test.go pattern: (state, action, podgroup mix) →
+(next state, status counts), driven through sync_queue and the
+Command-CR channel."""
+
+from __future__ import annotations
+
+import pytest
+
+from volcano_tpu.apis import bus, core, scheduling
+from volcano_tpu.client import APIServer, VolcanoClient
+from volcano_tpu.controllers.queue_controller import (
+    CLOSE_QUEUE_ACTION,
+    OPEN_QUEUE_ACTION,
+    QueueController,
+)
+
+OPEN = scheduling.QUEUE_STATE_OPEN
+CLOSED = scheduling.QUEUE_STATE_CLOSED
+CLOSING = scheduling.QUEUE_STATE_CLOSING
+
+
+def _env(queue_state="", podgroup_phases=()):
+    api = APIServer()
+    qc = QueueController(api)
+    vc = VolcanoClient(api)
+    vc.create_queue(
+        scheduling.Queue(
+            metadata=core.ObjectMeta(name="q", namespace=""),
+            spec=scheduling.QueueSpec(weight=1, state=queue_state),
+        )
+    )
+    for i, phase in enumerate(podgroup_phases):
+        vc.create_pod_group(
+            scheduling.PodGroup(
+                metadata=core.ObjectMeta(name=f"pg{i}", namespace="ns"),
+                spec=scheduling.PodGroupSpec(min_member=1, queue="q"),
+                status=scheduling.PodGroupStatus(phase=phase),
+            )
+        )
+    qc.drain()  # consume creation events
+    return api, qc, vc
+
+
+P, R, I = (
+    scheduling.POD_GROUP_PENDING,
+    scheduling.POD_GROUP_RUNNING,
+    scheduling.POD_GROUP_INQUEUE,
+)
+
+CASES = [
+    # (start state, action, podgroup phases, expected end state)
+    (OPEN, "", (P, R), OPEN),
+    (OPEN, CLOSE_QUEUE_ACTION, (R,), CLOSING),   # drains first
+    (OPEN, CLOSE_QUEUE_ACTION, (), CLOSED),      # nothing active → Closed
+    (CLOSING, "", (), CLOSED),                   # drain completes
+    (CLOSING, "", (R,), CLOSING),                # still active
+    (CLOSED, OPEN_QUEUE_ACTION, (), OPEN),
+    (CLOSING, OPEN_QUEUE_ACTION, (R,), OPEN),
+    (CLOSED, "", (), CLOSED),
+]
+
+
+@pytest.mark.parametrize(
+    "start,action,phases,end", CASES,
+    ids=[f"{c[0]}-{c[1] or 'sync'}-{len(c[2])}pg" for c in CASES],
+)
+def test_queue_state_table(start, action, phases, end):
+    api, qc, vc = _env(queue_state=start, podgroup_phases=phases)
+    qc.sync_queue("q", action=action)
+    queue = vc.get_queue("q")
+    assert queue.spec.state == end
+    assert queue.status.state == end
+
+
+def test_status_counts_rollup():
+    api, qc, vc = _env(podgroup_phases=(P, P, R, I))
+    qc.sync_queue("q")
+    st = vc.get_queue("q").status
+    assert (st.pending, st.running, st.inqueue) == (2, 1, 1)
+
+
+def test_command_cr_drives_close_then_reopen():
+    """bus Command → controller consumes + deletes the CR, state moves
+    (queue_controller.go:138-155 / vcctl queue operate)."""
+    api, qc, vc = _env(podgroup_phases=(R,))
+    vc.create_command(
+        bus.Command(
+            metadata=core.ObjectMeta(name="cmd1", namespace=""),
+            action=CLOSE_QUEUE_ACTION,
+            target_object={"kind": "Queue", "name": "q"},
+        )
+    )
+    qc.drain()
+    assert vc.get_queue("q").spec.state == CLOSING
+    assert not vc.list_commands()  # CR consumed and deleted
+
+    # workload drains → Closed
+    api.delete("PodGroup", "ns", "pg0")
+    qc.drain()
+    qc.sync_queue("q")
+    assert vc.get_queue("q").spec.state == CLOSED
+
+    vc.create_command(
+        bus.Command(
+            metadata=core.ObjectMeta(name="cmd2", namespace=""),
+            action=OPEN_QUEUE_ACTION,
+            target_object={"kind": "Queue", "name": "q"},
+        )
+    )
+    qc.drain()
+    assert vc.get_queue("q").spec.state == OPEN
