@@ -30,14 +30,10 @@ def _env(queue_state="", podgroup_phases=()):
             spec=scheduling.QueueSpec(weight=1, state=queue_state),
         )
     )
+    from tests.builders import build_pod_group
+
     for i, phase in enumerate(podgroup_phases):
-        vc.create_pod_group(
-            scheduling.PodGroup(
-                metadata=core.ObjectMeta(name=f"pg{i}", namespace="ns"),
-                spec=scheduling.PodGroupSpec(min_member=1, queue="q"),
-                status=scheduling.PodGroupStatus(phase=phase),
-            )
-        )
+        vc.create_pod_group(build_pod_group("ns", f"pg{i}", 1, queue="q", phase=phase))
     qc.drain()  # consume creation events
     return api, qc, vc
 
@@ -55,6 +51,8 @@ CASES = [
     (OPEN, CLOSE_QUEUE_ACTION, (), CLOSED),      # nothing active → Closed
     (CLOSING, "", (), CLOSED),                   # drain completes
     (CLOSING, "", (R,), CLOSING),                # still active
+    (CLOSING, "", (P,), CLOSING),                # pending also blocks
+    (CLOSING, "", (I,), CLOSING),                # inqueue also blocks
     (CLOSED, OPEN_QUEUE_ACTION, (), OPEN),
     (CLOSING, OPEN_QUEUE_ACTION, (R,), OPEN),
     (CLOSED, "", (), CLOSED),
@@ -88,7 +86,7 @@ def test_command_cr_drives_close_then_reopen():
         bus.Command(
             metadata=core.ObjectMeta(name="cmd1", namespace=""),
             action=CLOSE_QUEUE_ACTION,
-            target_object={"kind": "Queue", "name": "q"},
+            target_object=core.OwnerReference(kind="Queue", name="q"),
         )
     )
     qc.drain()
@@ -105,7 +103,7 @@ def test_command_cr_drives_close_then_reopen():
         bus.Command(
             metadata=core.ObjectMeta(name="cmd2", namespace=""),
             action=OPEN_QUEUE_ACTION,
-            target_object={"kind": "Queue", "name": "q"},
+            target_object=core.OwnerReference(kind="Queue", name="q"),
         )
     )
     qc.drain()
