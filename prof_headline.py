@@ -1,0 +1,82 @@
+"""Scratch: profile the headline-config device path, isolating
+(1) pure kernel device time with pre-staged arrays,
+(2) single-pass vs fused while_loop session,
+(3) host packing cost, (4) full run_packed_pallas e2e.
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from volcano_tpu.ops.synthetic import generate_snapshot, BASELINE_CONFIGS
+from volcano_tpu.ops.pallas_session import (
+    prepare_pallas_arrays,
+    schedule_pass_pallas,
+    schedule_session_pallas_packed,
+    run_packed_pallas,
+)
+
+snap = generate_snapshot(**BASELINE_CONFIGS["50k_pods_10k_nodes_gang_predicates"])
+
+
+def t(fn, n=5, warmup=2):
+    for _ in range(warmup):
+        fn()
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return np.median(ts) * 1e3
+
+
+# Host packing cost
+t0 = time.perf_counter()
+arrays, T_act, NK = prepare_pallas_arrays(snap)
+pack_ms = (time.perf_counter() - t0) * 1e3
+
+# Build the packed taskrow_ext exactly like run_packed_pallas
+T_rows = arrays["taskrow"].shape[0]
+taskrow_ext = np.zeros((T_rows, arrays["taskrow"].shape[1] + 1), np.float32)
+taskrow_ext[:, :-1] = arrays["taskrow"]
+n_act = min(snap.n_tasks, T_act)
+taskrow_ext[:n_act, -2] = 1.0
+n_tj = min(T_act, snap.task_job.shape[0])
+taskrow_ext[:n_tj, -1] = snap.task_job[:n_tj].astype(np.float32)
+jobs2 = np.stack([
+    snap.job_min_available.astype(np.int32),
+    snap.job_ready_count.astype(np.int32),
+])
+
+# Pre-stage on device
+d_ext = jax.device_put(jnp.asarray(taskrow_ext))
+d_cf = jax.device_put(jnp.asarray(arrays["cf_u8"]))
+d_nd = jax.device_put(jnp.asarray(arrays["nd"]))
+d_tol = jax.device_put(jnp.asarray(arrays["tol"]))
+d_jobs2 = jax.device_put(jnp.asarray(jobs2))
+jax.block_until_ready([d_ext, d_cf, d_nd, d_tol, d_jobs2])
+
+R = taskrow_ext.shape[1] - 3
+taskrow1 = taskrow_ext[:, : R + 2].copy()
+taskrow1[:n_act, R + 1] = 1.0
+d_tr1 = jax.device_put(jnp.asarray(taskrow1))
+jax.block_until_ready(d_tr1)
+
+# 1. single pass, device-resident
+single = t(lambda: jax.block_until_ready(
+    schedule_pass_pallas(d_tr1, d_cf, d_nd, d_tol)))
+# 2. fused session while_loop, device-resident
+fused = t(lambda: jax.block_until_ready(
+    schedule_session_pallas_packed(d_ext, d_cf, d_nd, d_tol, d_jobs2)))
+# 2b. fused with gang_rounds=1
+fused1 = t(lambda: jax.block_until_ready(
+    schedule_session_pallas_packed(d_ext, d_cf, d_nd, d_tol, d_jobs2,
+                                   gang_rounds=1)))
+# 3. full e2e (pack + transfer + run + fetch)
+e2e = t(lambda: run_packed_pallas(snap), n=3, warmup=1)
+
+print(f"pack_ms           {pack_ms:8.2f}")
+print(f"single_pass_ms    {single:8.2f}  (device-resident)")
+print(f"fused_session_ms  {fused:8.2f}  (device-resident, gang_rounds=3)")
+print(f"fused_rounds1_ms  {fused1:8.2f}  (device-resident, gang_rounds=1)")
+print(f"full_e2e_ms       {e2e:8.2f}")

@@ -1,0 +1,83 @@
+"""Scratch: break the 200ms e2e into pack / transfer / kernel / fetch,
+forcing a real device fetch (np.asarray) since the tunnel's
+block_until_ready may not round-trip."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from volcano_tpu.ops.synthetic import generate_snapshot, BASELINE_CONFIGS
+from volcano_tpu.ops.pallas_session import (
+    prepare_pallas_arrays,
+    schedule_session_pallas_packed,
+    run_packed_pallas,
+)
+
+snap = generate_snapshot(**BASELINE_CONFIGS["50k_pods_10k_nodes_gang_predicates"])
+
+
+def t(fn, n=5, warmup=2):
+    for _ in range(warmup):
+        fn()
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return np.median(ts) * 1e3, ts
+
+
+arrays, T_act, NK = prepare_pallas_arrays(snap)
+T_rows = arrays["taskrow"].shape[0]
+taskrow_ext = np.zeros((T_rows, arrays["taskrow"].shape[1] + 1), np.float32)
+taskrow_ext[:, :-1] = arrays["taskrow"]
+n_act = min(snap.n_tasks, T_act)
+taskrow_ext[:n_act, -2] = 1.0
+n_tj = min(T_act, snap.task_job.shape[0])
+taskrow_ext[:n_tj, -1] = snap.task_job[:n_tj].astype(np.float32)
+jobs2 = np.stack([
+    snap.job_min_available.astype(np.int32),
+    snap.job_ready_count.astype(np.int32),
+])
+
+sizes = dict(
+    taskrow_ext=taskrow_ext.nbytes,
+    cf_u8=arrays["cf_u8"].nbytes,
+    nd=arrays["nd"].nbytes,
+    tol=arrays["tol"].nbytes,
+    jobs2=jobs2.nbytes,
+)
+print("transfer bytes:", {k: f"{v/1e6:.2f}MB" for k, v in sizes.items()},
+      "total", f"{sum(sizes.values())/1e6:.2f}MB")
+
+# device-resident + REAL fetch of the [T] result
+d_ext = jax.device_put(jnp.asarray(taskrow_ext))
+d_cf = jax.device_put(jnp.asarray(arrays["cf_u8"]))
+d_nd = jax.device_put(jnp.asarray(arrays["nd"]))
+d_tol = jax.device_put(jnp.asarray(arrays["tol"]))
+d_jobs2 = jax.device_put(jnp.asarray(jobs2))
+_ = np.asarray(schedule_session_pallas_packed(d_ext, d_cf, d_nd, d_tol, d_jobs2))
+
+m, _ = t(lambda: np.asarray(
+    schedule_session_pallas_packed(d_ext, d_cf, d_nd, d_tol, d_jobs2)))
+print(f"kernel+fetch (device-resident inputs): {m:8.2f} ms")
+
+# transfer-only: put all five buffers fresh + tiny roundtrip to sync
+def put_all():
+    a = jnp.asarray(taskrow_ext)
+    b = jnp.asarray(arrays["cf_u8"])
+    c = jnp.asarray(arrays["nd"])
+    d = jnp.asarray(arrays["tol"])
+    e = jnp.asarray(jobs2)
+    return np.asarray(a[0, :1])  # force sync
+
+m, _ = t(put_all)
+print(f"transfer all inputs + sync:           {m:8.2f} ms")
+
+# single roundtrip: tiny put + tiny fetch
+m, _ = t(lambda: np.asarray(jnp.asarray(np.zeros(8, np.float32)) + 1))
+print(f"tiny RTT:                              {m:8.2f} ms")
+
+# full e2e again for reference
+m, ts = t(lambda: run_packed_pallas(snap), n=5, warmup=1)
+print(f"run_packed_pallas e2e:                 {m:8.2f} ms  {['%.0f' % (x*1e3) for x in ts]}")
