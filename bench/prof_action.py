@@ -1,0 +1,79 @@
+"""Profile the REAL jax-allocate action through a live Session at scale:
+session open (snapshot deep copy), ORDER replay, KERNEL, APPLY loop.
+
+Usage: python bench/prof_action.py [n_tasks] [n_nodes] [gang]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "tests")
+
+import numpy as np
+
+from tests.builders import build_node, build_pod, build_pod_group, build_queue
+from tests.scheduler_helpers import make_cache, tiers
+from volcano_tpu.actions.jax_allocate import JaxAllocateAction, compute_task_order
+from volcano_tpu.framework import close_session, open_session
+
+n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 5_000
+n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 1_000
+gang = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+
+TIERS = tiers(
+    ["priority", "gang"],
+    ["drf", "predicates", "proportion", "nodeorder", "binpack"],
+)
+
+rng = np.random.RandomState(0)
+t0 = time.perf_counter()
+nodes = [build_node(f"n{i}", {"cpu": "64", "memory": "256G"}) for i in range(n_nodes)]
+n_jobs = max(1, n_tasks // gang)
+pods, pgs = [], []
+cpus = rng.choice(["250m", "500m", "1", "2", "4"], size=n_tasks)
+mems = rng.choice(["256Mi", "512Mi", "1Gi", "2Gi", "4Gi", "8Gi"], size=n_tasks)
+for j in range(n_jobs):
+    pgs.append(build_pod_group("ns", f"pg{j}", gang, queue="q"))
+for i in range(n_tasks):
+    j = min(i // gang, n_jobs - 1)
+    pods.append(
+        build_pod("ns", f"j{j}-t{i}", "", {"cpu": cpus[i], "memory": mems[i]}, group=f"pg{j}")
+    )
+build_s = time.perf_counter() - t0
+
+t0 = time.perf_counter()
+cache = make_cache(nodes=nodes, pods=pods, pod_groups=pgs, queues=[build_queue("q")])
+cache_s = time.perf_counter() - t0
+
+t0 = time.perf_counter()
+ssn = open_session(cache, TIERS, [])
+open_s = time.perf_counter() - t0
+
+t0 = time.perf_counter()
+order = compute_task_order(ssn)
+order_s = time.perf_counter() - t0
+
+action = JaxAllocateAction()
+t0 = time.perf_counter()
+proposals = action._kernel_proposals(ssn, order)
+kernel_s = time.perf_counter() - t0
+
+t0 = time.perf_counter()
+action.execute(ssn)
+full_s = time.perf_counter() - t0
+t0 = time.perf_counter()
+close_session(ssn)
+close_s = time.perf_counter() - t0
+
+binds = len(cache.binder.binds)
+print(f"tasks={n_tasks} nodes={n_nodes} jobs={n_jobs} binds={binds}")
+print(f"build_objects_s   {build_s:8.3f}")
+print(f"cache_feed_s      {cache_s:8.3f}")
+print(f"session_open_s    {open_s:8.3f}")
+print(f"order_s           {order_s:8.3f}  ({order_s/n_tasks*1e6:.1f} us/task)")
+print(f"kernel_s          {kernel_s:8.3f}")
+print(f"apply(full2nd)_s  {full_s:8.3f}  (order+kernel+apply; {full_s/n_tasks*1e6:.1f} us/task)")
+print(f"close_s           {close_s:8.3f}")

@@ -1,0 +1,105 @@
+"""Instrumented apply-phase profile: counts kernel-proposal hits,
+validation failures, and host fallbacks inside the real action."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "tests")
+
+import numpy as np
+
+from tests.builders import build_node, build_pod, build_pod_group, build_queue
+from tests.scheduler_helpers import make_cache, tiers
+from volcano_tpu.actions.allocate import (
+    drive_allocate_loop,
+    gang_end_job,
+    host_node_chooser,
+    make_place_task,
+    make_predicate_fn,
+)
+from volcano_tpu.actions.jax_allocate import JaxAllocateAction, compute_task_order
+from volcano_tpu.api import FitError
+from volcano_tpu.framework import close_session, open_session
+
+n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 5_000
+n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 1_000
+gang = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+
+TIERS = tiers(
+    ["priority", "gang"],
+    ["drf", "predicates", "proportion", "nodeorder", "binpack"],
+)
+
+rng = np.random.RandomState(0)
+nodes = [build_node(f"n{i}", {"cpu": "64", "memory": "256G"}) for i in range(n_nodes)]
+n_jobs = max(1, n_tasks // gang)
+pods, pgs = [], []
+cpus = rng.choice(["250m", "500m", "1", "2", "4"], size=n_tasks)
+mems = rng.choice(["256Mi", "512Mi", "1Gi", "2Gi", "4Gi", "8Gi"], size=n_tasks)
+for j in range(n_jobs):
+    pgs.append(build_pod_group("ns", f"pg{j}", gang, queue="q"))
+for i in range(n_tasks):
+    j = min(i // gang, n_jobs - 1)
+    pods.append(
+        build_pod("ns", f"j{j}-t{i}", "", {"cpu": cpus[i], "memory": mems[i]}, group=f"pg{j}")
+    )
+cache = make_cache(nodes=nodes, pods=pods, pod_groups=pgs, queues=[build_queue("q")])
+ssn = open_session(cache, TIERS, [])
+
+action = JaxAllocateAction()
+t0 = time.perf_counter()
+ordered = compute_task_order(ssn)
+order_s = time.perf_counter() - t0
+t0 = time.perf_counter()
+proposals = action._kernel_proposals(ssn, ordered)
+kernel_s = time.perf_counter() - t0
+
+stats = dict(hit=0, miss=0, vfail=0, fallback_s=0.0, validate_s=0.0, place_s=0.0)
+predicate_fn = make_predicate_fn(ssn)
+host_choose = host_node_chooser(ssn)
+
+
+def choose_node(task, job):
+    name = proposals.get(task.uid)
+    if name is not None:
+        node = ssn.nodes.get(name)
+        if node is not None:
+            t0 = time.perf_counter()
+            try:
+                predicate_fn(task, node)
+                stats["validate_s"] += time.perf_counter() - t0
+                stats["hit"] += 1
+                return node
+            except FitError:
+                stats["validate_s"] += time.perf_counter() - t0
+                stats["vfail"] += 1
+    else:
+        stats["miss"] += 1
+    t0 = time.perf_counter()
+    n = host_choose(task, job)
+    stats["fallback_s"] += time.perf_counter() - t0
+    return n
+
+
+t0 = time.perf_counter()
+drive_allocate_loop(
+    ssn,
+    begin_job=lambda job: ssn.statement(),
+    place_task=make_place_task(ssn, choose_node),
+    end_job=gang_end_job(ssn),
+)
+apply_s = time.perf_counter() - t0
+close_session(ssn)
+
+binds = len(cache.binder.binds)
+print(f"tasks={n_tasks} binds={binds} proposals={len(proposals)}")
+print(f"order_s     {order_s:8.3f}")
+print(f"kernel_s    {kernel_s:8.3f}")
+print(f"apply_s     {apply_s:8.3f}")
+print(f"  hits={stats['hit']} vfail={stats['vfail']} miss={stats['miss']}")
+print(f"  validate_s {stats['validate_s']:8.3f}")
+print(f"  fallback_s {stats['fallback_s']:8.3f}")
+print(f"  loop_overhead_s {apply_s - stats['validate_s'] - stats['fallback_s']:8.3f}")

@@ -1,0 +1,134 @@
+"""Warm-path decomposition of the real jax-allocate action at scale:
+order / pack / device / proposals / apply-loop breakdown, second run."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "tests")
+
+import numpy as np
+
+from tests.builders import build_node, build_pod, build_pod_group, build_queue
+from tests.scheduler_helpers import make_cache, tiers
+from volcano_tpu.actions.allocate import (
+    drive_allocate_loop,
+    gang_end_job,
+    host_node_chooser,
+    make_place_task,
+    make_predicate_fn,
+)
+from volcano_tpu.actions.jax_allocate import JaxAllocateAction, compute_task_order
+from volcano_tpu.api import FitError
+from volcano_tpu.framework import close_session, open_session
+from volcano_tpu.ops.dispatch import run_packed_auto, select_executor
+from volcano_tpu.ops.packing import pack_session
+
+n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+gang = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+
+TIERS = tiers(
+    ["priority", "gang"],
+    ["drf", "predicates", "proportion", "nodeorder", "binpack"],
+)
+
+rng = np.random.RandomState(0)
+nodes = [build_node(f"n{i}", {"cpu": "64", "memory": "256G"}) for i in range(n_nodes)]
+n_jobs = max(1, n_tasks // gang)
+pods, pgs = [], []
+cpus = rng.choice(["250m", "500m", "1", "2", "4"], size=n_tasks)
+mems = rng.choice(["256Mi", "512Mi", "1Gi", "2Gi", "4Gi", "8Gi"], size=n_tasks)
+for j in range(n_jobs):
+    pgs.append(build_pod_group("ns", f"pg{j}", gang, queue="q"))
+for i in range(n_tasks):
+    j = min(i // gang, n_jobs - 1)
+    pods.append(
+        build_pod("ns", f"j{j}-t{i}", "", {"cpu": cpus[i], "memory": mems[i]}, group=f"pg{j}")
+    )
+# warm run: compile everything once (bindings mutate the cache, so the
+# measured run gets a freshly-built cache)
+cache = make_cache(nodes=nodes, pods=pods, pod_groups=pgs, queues=[build_queue("q")])
+ssn = open_session(cache, TIERS, [])
+JaxAllocateAction().execute(ssn)
+close_session(ssn)
+
+# measured run
+cache = make_cache(nodes=nodes, pods=pods, pod_groups=pgs, queues=[build_queue("q")])
+t0 = time.perf_counter()
+ssn = open_session(cache, TIERS, [])
+open_s = time.perf_counter() - t0
+
+t0 = time.perf_counter()
+ordered = compute_task_order(ssn)
+order_s = time.perf_counter() - t0
+
+jobs = {}
+for t in ordered:
+    job = ssn.jobs.get(t.job)
+    if job is not None and job.uid not in jobs:
+        jobs[job.uid] = job
+node_list = [ssn.nodes[name] for name in sorted(ssn.nodes)]
+
+t0 = time.perf_counter()
+snap = pack_session(ordered, list(jobs.values()), node_list,
+                    enforce_pod_count="predicates" in ssn.predicate_fns)
+pack_s = time.perf_counter() - t0
+
+print("executor:", select_executor(snap))
+t0 = time.perf_counter()
+assignment = run_packed_auto(snap)
+device_s = time.perf_counter() - t0
+
+t0 = time.perf_counter()
+proposals = {}
+for i, task in enumerate(ordered):
+    if assignment[i] >= 0 and not snap.task_has_preferences[i]:
+        proposals[task.uid] = node_list[assignment[i]].name
+prop_s = time.perf_counter() - t0
+
+predicate_fn = make_predicate_fn(ssn)
+host_choose = host_node_chooser(ssn)
+stats = dict(hit=0, vfail=0, miss=0)
+
+
+def choose_node(task, job):
+    name = proposals.get(task.uid)
+    if name is not None:
+        node = ssn.nodes.get(name)
+        if node is not None:
+            try:
+                predicate_fn(task, node)
+                stats["hit"] += 1
+                return node
+            except FitError:
+                stats["vfail"] += 1
+    else:
+        stats["miss"] += 1
+    return host_choose(task, job)
+
+
+t0 = time.perf_counter()
+drive_allocate_loop(
+    ssn,
+    begin_job=lambda job: ssn.statement(),
+    place_task=make_place_task(ssn, choose_node),
+    end_job=gang_end_job(ssn),
+)
+apply_s = time.perf_counter() - t0
+t0 = time.perf_counter()
+close_session(ssn)
+close_s = time.perf_counter() - t0
+
+total = open_s + order_s + pack_s + device_s + prop_s + apply_s
+print(f"tasks={n_tasks} stats={stats}")
+print(f"open_s     {open_s:8.3f}")
+print(f"order_s    {order_s:8.3f}")
+print(f"pack_s     {pack_s:8.3f}")
+print(f"device_s   {device_s:8.3f}")
+print(f"prop_s     {prop_s:8.3f}")
+print(f"apply_s    {apply_s:8.3f}")
+print(f"close_s    {close_s:8.3f}")
+print(f"TOTAL(open..apply) {total:8.3f}")

@@ -153,8 +153,6 @@ def _make_kernel(R: int, TB: int, NS: int, weights: ScoreWeights):
         ctrl_s,  # scratch SMEM [2] i32 — have_prev, prev_best (-1 = none)
     ):
         i = pl.program_id(0)
-        base_ref = lambda r: nd_ref[r]
-        alloc_ref = lambda r: nd_ref[R + r]
 
         @pl.when(i == 0)
         def _():

@@ -43,14 +43,21 @@ def generate_snapshot(
     snap.tolerance = np.array([MIN_MILLI_CPU, MIN_MEMORY / MIB], dtype=np.float32)
     snap.n_tasks, snap.n_nodes, snap.n_jobs = n_tasks, n_nodes, n_jobs
 
-    # Tasks: cpu 250m-4000m, memory 256MiB-8GiB, MiB-aligned.
-    cpu = rng.choice([250, 500, 1000, 2000, 4000], size=n_tasks).astype(np.float32)
-    mem = rng.choice([256, 512, 1024, 2048, 4096, 8192], size=n_tasks).astype(np.float32)
+    # Tasks: cpu 250m-4000m, memory 256MiB-8GiB, MiB-aligned.  Gang
+    # replicas share ONE resreq per job — the reference's gangs stamp all
+    # replicas of a task group from a single PodTemplate
+    # (pkg/apis/batch/v1alpha1/job.go:43-60), so per-job (not per-task)
+    # randomization is what a real cluster of this shape looks like.
+    job_cpu = rng.choice([250, 500, 1000, 2000, 4000], size=n_jobs).astype(np.float32)
+    job_mem = rng.choice([256, 512, 1024, 2048, 4096, 8192], size=n_jobs).astype(np.float32)
+    task_of_job = np.minimum(np.arange(n_tasks) // gang_size, n_jobs - 1)
+    cpu = job_cpu[task_of_job]
+    mem = job_mem[task_of_job]
     snap.task_resreq = np.zeros((T_pad, R), dtype=np.float32)
     snap.task_resreq[:n_tasks, 0] = cpu
     snap.task_resreq[:n_tasks, 1] = mem
     snap.task_job = np.zeros(T_pad, dtype=np.int32)
-    snap.task_job[:n_tasks] = np.minimum(np.arange(n_tasks) // gang_size, n_jobs - 1)
+    snap.task_job[:n_tasks] = task_of_job
 
     snap.task_sel_bits = np.zeros((T_pad, W), dtype=np.uint32)
     snap.task_tol_bits = np.zeros((T_pad, W), dtype=np.uint32)

@@ -102,7 +102,7 @@ class PredicatesPlugin(Plugin):
             if pod.spec.affinity and (
                 pod.spec.affinity.get("podAffinity")
                 or pod.spec.affinity.get("podAntiAffinity")
-            ) or self._any_pod_anti_affinity(pl):
+            ) or pl.any_required_anti_affinity():
                 if not putil.pod_affinity_predicate(
                     pod, node, ssn.nodes, pl.assigned_pods()
                 ):
@@ -130,19 +130,6 @@ class PredicatesPlugin(Plugin):
                     )
 
         ssn.add_predicate_fn(self.name(), predicate_fn)
-
-    @staticmethod
-    def _any_pod_anti_affinity(pl: putil.PodLister) -> bool:
-        """Symmetry requires the check when any existing pod declares
-        required anti-affinity."""
-        for pod, _ in pl.assigned_pods():
-            aff = pod.spec.affinity or {}
-            anti = (aff.get("podAntiAffinity") or {}).get(
-                "requiredDuringSchedulingIgnoredDuringExecution"
-            )
-            if anti:
-                return True
-        return False
 
 
 def new(arguments: Arguments) -> Plugin:

@@ -217,13 +217,32 @@ class PodLister:
         self.session = session
         # task uid -> (pod, node_name); node objects resolved via session.
         self._task_nodes: Dict[str, str] = {}
+        # Assigned tasks whose pod declares required anti-affinity —
+        # maintained incrementally so the symmetry gate in the predicates
+        # plugin is O(1) per call instead of an O(tasks) sweep (which made
+        # a session's predicate validation O(tasks²)).
+        self._assigned_anti_affinity: set = set()
+        self._has_anti_affinity: set = set()
         for job in session.jobs.values():
             for task in job.tasks.values():
                 if task.pod is not None:
                     self._task_nodes[task.uid] = task.node_name
+                    if _affinity_terms(task.pod, "podAntiAffinity"):
+                        self._has_anti_affinity.add(task.uid)
+                        if task.node_name:
+                            self._assigned_anti_affinity.add(task.uid)
 
     def update_task(self, task: TaskInfo, node_name: str) -> None:
         self._task_nodes[task.uid] = node_name
+        if task.uid in self._has_anti_affinity:
+            if node_name:
+                self._assigned_anti_affinity.add(task.uid)
+            else:
+                self._assigned_anti_affinity.discard(task.uid)
+
+    def any_required_anti_affinity(self) -> bool:
+        """True iff any assigned pod declares required anti-affinity."""
+        return bool(self._assigned_anti_affinity)
 
     def pods_on_node(self, node: NodeInfo) -> List[core.Pod]:
         return [t.pod for t in node.tasks.values() if t.pod is not None]
