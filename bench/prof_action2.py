@@ -34,7 +34,7 @@ TIERS = tiers(
 )
 
 rng = np.random.RandomState(0)
-nodes = [build_node(f"n{i}", {"cpu": "64", "memory": "256G"}) for i in range(n_nodes)]
+nodes = [build_node(f"n{i}", {"cpu": "64", "memory": "256Gi"}) for i in range(n_nodes)]
 n_jobs = max(1, n_tasks // gang)
 pods, pgs = [], []
 cpus = rng.choice(["250m", "500m", "1", "2", "4"], size=n_tasks)
@@ -54,7 +54,7 @@ t0 = time.perf_counter()
 ordered = compute_task_order(ssn)
 order_s = time.perf_counter() - t0
 t0 = time.perf_counter()
-proposals = action._kernel_proposals(ssn, ordered)
+proposals, _snap = action._kernel_proposals(ssn, ordered)
 kernel_s = time.perf_counter() - t0
 
 stats = dict(hit=0, miss=0, vfail=0, fallback_s=0.0, validate_s=0.0, place_s=0.0)

@@ -36,7 +36,7 @@ TIERS = tiers(
 )
 
 rng = np.random.RandomState(0)
-nodes = [build_node(f"n{i}", {"cpu": "64", "memory": "256G"}) for i in range(n_nodes)]
+nodes = [build_node(f"n{i}", {"cpu": "64", "memory": "256Gi"}) for i in range(n_nodes)]
 n_jobs = max(1, n_tasks // gang)
 pods, pgs = [], []
 cpus = rng.choice(["250m", "500m", "1", "2", "4"], size=n_tasks)

@@ -1,0 +1,238 @@
+"""fast_apply equivalence: the bulk commit must leave session + cache
+state identical to the slow drive_allocate_loop/Statement path — exact
+floats, dict contents and insertion orders, binder calls, plugin state."""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+import pytest
+
+import volcano_tpu.actions.jax_allocate as ja
+from volcano_tpu.actions.fast_apply import try_fast_apply
+from volcano_tpu.actions.jax_allocate import JaxAllocateAction
+from volcano_tpu.api import TaskStatus
+from volcano_tpu.framework import close_session, open_session
+
+from tests.builders import build_node, build_pod, build_pod_group, build_queue
+from tests.scheduler_helpers import make_cache, tiers
+
+STANDARD = lambda: tiers(
+    ["priority", "gang"],
+    ["drf", "predicates", "proportion", "nodeorder", "binpack"],
+)
+
+
+def _cluster(n_jobs=6, gang=4, min_avail=None, n_nodes=6, seed=0, queues=None):
+    rng = np.random.RandomState(seed)
+    nodes = [build_node(f"n{i}", {"cpu": "16", "memory": "64Gi"}) for i in range(n_nodes)]
+    pods, pgs = [], []
+    qnames = [q.metadata.name for q in (queues or [build_queue("q")])]
+    for j in range(n_jobs):
+        pgs.append(
+            build_pod_group("ns", f"pg{j}", min_avail or gang,
+                            queue=qnames[j % len(qnames)])
+        )
+        for i in range(gang):
+            cpu = ["500m", "1", "2"][rng.randint(3)]
+            pods.append(
+                build_pod("ns", f"j{j}-t{i}", "", {"cpu": cpu, "memory": "1Gi"},
+                          group=f"pg{j}")
+            )
+    return dict(nodes=nodes, pods=pods, pod_groups=pgs,
+                queues=queues or [build_queue("q")])
+
+
+def _run(cluster, force_slow, monkeypatch=None):
+    cache = make_cache(**copy.deepcopy(cluster))
+    ssn = open_session(cache, STANDARD(), [])
+    engaged = {"fast": False}
+    if force_slow:
+        orig_import = ja.__dict__
+        import volcano_tpu.actions.fast_apply as fa
+
+        real = fa.try_fast_apply
+        fa.try_fast_apply = lambda *a, **k: False
+        try:
+            JaxAllocateAction().execute(ssn)
+        finally:
+            fa.try_fast_apply = real
+    else:
+        import volcano_tpu.actions.fast_apply as fa
+
+        real = fa.try_fast_apply
+
+        def spy(*a, **k):
+            engaged["fast"] = real(*a, **k)
+            return engaged["fast"]
+
+        fa.try_fast_apply = spy
+        try:
+            JaxAllocateAction().execute(ssn)
+        finally:
+            fa.try_fast_apply = real
+    return cache, ssn, engaged["fast"]
+
+
+def _assert_state_equal(a, b):
+    cache_a, ssn_a = a
+    cache_b, ssn_b = b
+    assert cache_a.binder.binds == cache_b.binder.binds
+
+    assert set(ssn_a.jobs) == set(ssn_b.jobs)
+    for uid in ssn_a.jobs:
+        ja_, jb = ssn_a.jobs[uid], ssn_b.jobs[uid]
+        assert ja_.allocated.milli_cpu == jb.allocated.milli_cpu, uid
+        assert ja_.allocated.memory == jb.allocated.memory, uid
+        assert ja_.total_request.milli_cpu == jb.total_request.milli_cpu, uid
+        assert list(ja_.tasks) == list(jb.tasks), uid  # insertion order
+        assert {
+            s: set(ts) for s, ts in ja_.task_status_index.items()
+        } == {s: set(ts) for s, ts in jb.task_status_index.items()}, uid
+        for t_uid, ta in ja_.tasks.items():
+            tb = jb.tasks[t_uid]
+            assert ta.status == tb.status
+            assert ta.node_name == tb.node_name
+            assert ta.volume_ready == tb.volume_ready
+
+    assert set(ssn_a.nodes) == set(ssn_b.nodes)
+    for name in ssn_a.nodes:
+        na, nb = ssn_a.nodes[name], ssn_b.nodes[name]
+        assert na.idle.milli_cpu == nb.idle.milli_cpu, name
+        assert na.idle.memory == nb.idle.memory, name
+        assert na.used.milli_cpu == nb.used.milli_cpu, name
+        assert na.used.memory == nb.used.memory, name
+        assert list(na.tasks) == list(nb.tasks), name
+        for t_uid, ca in na.tasks.items():
+            cb = nb.tasks[t_uid]
+            assert ca.status == cb.status and ca.node_name == cb.node_name
+
+    # plugin internal state (consumed by later actions in the session)
+    for pname in ("drf", "proportion"):
+        pa, pb = ssn_a.plugins[pname], ssn_b.plugins[pname]
+        if pname == "drf":
+            assert set(pa.job_attrs) == set(pb.job_attrs)
+            for uid in pa.job_attrs:
+                assert pa.job_attrs[uid].share == pb.job_attrs[uid].share, uid
+                assert (
+                    pa.job_attrs[uid].allocated.milli_cpu
+                    == pb.job_attrs[uid].allocated.milli_cpu
+                )
+            assert set(pa.namespace_opts) == set(pb.namespace_opts)
+            for ns in pa.namespace_opts:
+                assert pa.namespace_opts[ns].share == pb.namespace_opts[ns].share
+        else:
+            assert set(pa.queue_opts) == set(pb.queue_opts)
+            for q in pa.queue_opts:
+                assert pa.queue_opts[q].share == pb.queue_opts[q].share, q
+                assert (
+                    pa.queue_opts[q].allocated.milli_cpu
+                    == pb.queue_opts[q].allocated.milli_cpu
+                )
+
+    # cache-side state
+    for uid in cache_a.jobs:
+        ca, cb = cache_a.jobs[uid], cache_b.jobs[uid]
+        assert {s: set(ts) for s, ts in ca.task_status_index.items()} == {
+            s: set(ts) for s, ts in cb.task_status_index.items()
+        }
+    for name in cache_a.nodes:
+        na, nb = cache_a.nodes[name], cache_b.nodes[name]
+        assert na.idle.milli_cpu == nb.idle.milli_cpu
+        assert na.used.milli_cpu == nb.used.milli_cpu
+        assert list(na.tasks) == list(nb.tasks)
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(),                                   # simple gangs, one queue
+    dict(min_avail=2, gang=5),                # post-ready single-task episodes
+    dict(n_jobs=9, gang=3,
+         queues=[build_queue("qa", weight=3), build_queue("qb", weight=1)]),
+])
+def test_fast_apply_matches_slow_path(kwargs):
+    cluster = _cluster(**kwargs)
+    cache_f, ssn_f, engaged = _run(cluster, force_slow=False)
+    assert engaged, "fast apply did not engage on an exact fully-placed session"
+    cache_s, ssn_s, _ = _run(cluster, force_slow=True)
+    _assert_state_equal((cache_f, ssn_f), (cache_s, ssn_s))
+    close_session(ssn_f)
+    close_session(ssn_s)
+    # post-close status writeback must agree too
+    assert {
+        (uid, j.pod_group.status.phase)
+        for uid, j in cache_f.jobs.items()
+        if j.pod_group is not None
+    } == {
+        (uid, j.pod_group.status.phase)
+        for uid, j in cache_s.jobs.items()
+        if j.pod_group is not None
+    }
+
+
+def test_fast_apply_refuses_partial_placement():
+    # one tiny node: most gangs cannot place -> partial -> refuse
+    cluster = _cluster(n_jobs=6, gang=4, n_nodes=1)
+    cluster["nodes"] = [build_node("n0", {"cpu": "4", "memory": "8Gi"})]
+    cache, ssn, engaged = _run(cluster, force_slow=False)
+    assert not engaged
+    close_session(ssn)
+
+
+def test_fast_apply_refuses_pvc_pods():
+    cluster = _cluster(n_jobs=2, gang=2)
+    pod = cluster["pods"][0]
+    from volcano_tpu.apis import core
+
+    pod.spec.volumes = [
+        core.Volume(name="v", source={"persistentVolumeClaim": {"claimName": "c"}})
+    ]
+    cache, ssn, engaged = _run(cluster, force_slow=False)
+    assert not engaged
+    close_session(ssn)
+
+
+def test_fast_apply_refuses_preassigned_anti_affinity():
+    """A RUNNING pod with required anti-affinity makes the host symmetry
+    predicate load-bearing for every placement; the packer cannot see it
+    (needs_host_validation covers only packed pending tasks), so the
+    bulk path must refuse and the slow path must enforce the spread."""
+    anti = {"podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+        {"labelSelector": {"matchLabels": {"app": "x"}},
+         "topologyKey": "kubernetes.io/hostname"}]}}
+    nodes = [
+        build_node(f"n{i}", {"cpu": "8", "memory": "16Gi"},
+                   labels={"kubernetes.io/hostname": f"n{i}"})
+        for i in range(2)
+    ]
+    pods = [
+        build_pod("ns", "guard", "n0", {"cpu": "1", "memory": "1Gi"},
+                  phase="Running", group="pgr", affinity=anti),
+        build_pod("ns", "t0", "", {"cpu": "1", "memory": "1Gi"}, group="pg",
+                  labels={"app": "x"}),
+        build_pod("ns", "t1", "", {"cpu": "1", "memory": "1Gi"}, group="pg",
+                  labels={"app": "x"}),
+    ]
+    pgs = [build_pod_group("ns", "pgr", 1, queue="q"),
+           build_pod_group("ns", "pg", 1, queue="q")]
+    cluster = dict(nodes=nodes, pods=pods, pod_groups=pgs, queues=[build_queue("q")])
+    cache, ssn, engaged = _run(cluster, force_slow=False)
+    assert not engaged
+    binds = dict(cache.binder.binds)
+    # slow path places the app=x pods only away from the guard's node
+    assert all(v != "n0" for k, v in binds.items() if k in ("ns/t0", "ns/t1"))
+    close_session(ssn)
+
+
+def test_fast_apply_refuses_unknown_plugin():
+    cluster = _cluster(n_jobs=2, gang=2)
+    cache = make_cache(**copy.deepcopy(cluster))
+    ssn = open_session(cache, STANDARD(), [])
+    try:
+        ssn.plugins["mystery"] = object()
+        ordered = ja.compute_task_order(ssn)
+        proposals, snap = JaxAllocateAction()._kernel_proposals(ssn, ordered)
+        assert snap is None or not try_fast_apply(ssn, ordered, proposals, snap)
+    finally:
+        del ssn.plugins["mystery"]
+        close_session(ssn)

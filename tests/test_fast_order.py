@@ -1,0 +1,169 @@
+"""fast_order equivalence: the episode-level ORDER simulation must
+reproduce the exact replay's pop order on every session shape it claims
+(and refuse the shapes it cannot model)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from volcano_tpu.actions.fast_order import try_compute_task_order
+from volcano_tpu.actions.jax_allocate import compute_task_order_replay
+from volcano_tpu.framework import close_session, open_session
+
+from tests.builders import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_priority_class,
+    build_queue,
+)
+from tests.scheduler_helpers import make_cache, tiers
+
+STANDARD = lambda: tiers(
+    ["priority", "gang"],
+    ["drf", "predicates", "proportion", "nodeorder", "binpack"],
+)
+
+
+def _uids(order):
+    return [t.uid for t in order]
+
+
+def _assert_equal_order(cluster, tier_conf=None):
+    cache = make_cache(**cluster)
+    ssn = open_session(cache, tier_conf or STANDARD(), [])
+    try:
+        fast = try_compute_task_order(ssn)
+        assert fast is not None, "fast path refused a standard session"
+        replay = compute_task_order_replay(ssn)
+        assert _uids(fast) == _uids(replay)
+        # the replay unwinds itself; running it after the simulation also
+        # proves the simulation touched no session state
+        assert _uids(compute_task_order_replay(ssn)) == _uids(replay)
+    finally:
+        close_session(ssn)
+    return len(_uids(fast := fast))
+
+
+def _gang_cluster(n_jobs=6, gang=4, min_avail=None, n_nodes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    nodes = [build_node(f"n{i}", {"cpu": "16", "memory": "64G"}) for i in range(n_nodes)]
+    pods, pgs = [], []
+    for j in range(n_jobs):
+        pgs.append(
+            build_pod_group("ns", f"pg{j}", min_avail or gang, queue="q")
+        )
+        for i in range(gang):
+            cpu = ["500m", "1", "2"][rng.randint(3)]
+            pods.append(
+                build_pod("ns", f"j{j}-t{i}", "", {"cpu": cpu, "memory": "1G"}, group=f"pg{j}")
+            )
+    return dict(nodes=nodes, pods=pods, pod_groups=pgs, queues=[build_queue("q")])
+
+
+def test_simple_gangs():
+    _assert_equal_order(_gang_cluster())
+
+
+def test_min_available_below_gang_size():
+    # phase B (one task per episode after readiness) is exercised
+    _assert_equal_order(_gang_cluster(n_jobs=5, gang=6, min_avail=2))
+
+
+def test_multi_queue_weights():
+    cluster = _gang_cluster(n_jobs=8, gang=3, min_avail=2)
+    queues = [build_queue("qa", weight=3), build_queue("qb", weight=1)]
+    for i, pg in enumerate(cluster["pod_groups"]):
+        pg.spec.queue = "qa" if i % 2 == 0 else "qb"
+    cluster["queues"] = queues
+    _assert_equal_order(cluster)
+
+
+def test_multi_namespace():
+    nodes = [build_node(f"n{i}", {"cpu": "8", "memory": "16G"}) for i in range(3)]
+    pods, pgs = [], []
+    for ns in ("alpha", "beta", "gamma"):
+        pgs.append(build_pod_group(ns, "pg", 2, queue="q"))
+        for i in range(4):
+            pods.append(
+                build_pod(ns, f"t{i}", "", {"cpu": "1", "memory": "1G"}, group="pg")
+            )
+    _assert_equal_order(
+        dict(nodes=nodes, pods=pods, pod_groups=pgs, queues=[build_queue("q")])
+    )
+
+
+def test_priorities_and_preallocated():
+    nodes = [build_node(f"n{i}", {"cpu": "16", "memory": "32G"}) for i in range(4)]
+    pcs = [build_priority_class("high", 1000), build_priority_class("low", 10)]
+    pods, pgs = [], []
+    # one job already partially running (nonzero initial drf share)
+    pgs.append(build_pod_group("ns", "warm", 2, queue="q"))
+    pods.append(
+        build_pod("ns", "warm-r0", "n0", {"cpu": "2", "memory": "2G"},
+                  phase="Running", group="warm")
+    )
+    for i in range(3):
+        pods.append(
+            build_pod("ns", f"warm-t{i}", "", {"cpu": "1", "memory": "1G"}, group="warm")
+        )
+    for j, pc in [(0, "high"), (1, "low"), (2, "high")]:
+        pg = build_pod_group("ns", f"pg{j}", 2, queue="q", priority_class_name=pc)
+        pgs.append(pg)
+        for i in range(3):
+            pods.append(
+                build_pod("ns", f"j{j}-t{i}", "", {"cpu": "1", "memory": "1G"}, group=f"pg{j}")
+            )
+    _assert_equal_order(
+        dict(nodes=nodes, pods=pods, pod_groups=pgs, queues=[build_queue("q")],
+             priority_classes=pcs)
+    )
+
+
+def test_best_effort_tasks_skipped():
+    nodes = [build_node("n0", {"cpu": "8", "memory": "16G"})]
+    pods, pgs = [], []
+    pgs.append(build_pod_group("ns", "pg", 1, queue="q"))
+    pods.append(build_pod("ns", "be", "", {}, group="pg"))  # empty resreq
+    pods.append(build_pod("ns", "real", "", {"cpu": "1", "memory": "1G"}, group="pg"))
+    _assert_equal_order(
+        dict(nodes=nodes, pods=pods, pod_groups=pgs, queues=[build_queue("q")])
+    )
+
+
+def test_seeded_fuzz_sessions():
+    for seed in range(6):
+        rng = np.random.RandomState(seed)
+        n_jobs = int(rng.randint(3, 12))
+        gang = int(rng.randint(1, 6))
+        min_avail = int(rng.randint(1, gang + 1))
+        _assert_equal_order(
+            _gang_cluster(n_jobs=n_jobs, gang=gang, min_avail=min_avail, seed=seed)
+        )
+
+
+def test_refuses_unknown_order_plugin():
+    """A session with a job-order comparator outside the modeled set must
+    return None (fall back to the replay), not guess."""
+    cache = make_cache(**_gang_cluster(n_jobs=2))
+    ssn = open_session(cache, STANDARD(), [])
+    try:
+        ssn.add_job_order_fn("custom", lambda l, r: 0)
+        ssn.tiers[0].plugins[0].name = "custom"  # masquerade an unknown name
+        # rebuild chain caches
+        ssn._ordered_chains.clear()
+        assert try_compute_task_order(ssn) is None
+    finally:
+        close_session(ssn)
+
+
+def test_order_used_by_action_is_identical():
+    from volcano_tpu.actions.jax_allocate import compute_task_order
+
+    cache = make_cache(**_gang_cluster(n_jobs=4, gang=3, min_avail=2))
+    ssn = open_session(cache, STANDARD(), [])
+    try:
+        assert _uids(compute_task_order(ssn)) == _uids(compute_task_order_replay(ssn))
+    finally:
+        close_session(ssn)

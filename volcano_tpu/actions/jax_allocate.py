@@ -53,7 +53,23 @@ log = get_logger(__name__)
 
 
 def compute_task_order(ssn: Session) -> List[TaskInfo]:
-    """Phase 1: replay the loop assuming every task places, recording pop
+    """Phase 1: the task processing order.
+
+    Sessions whose ordering semantics match the standard plugin shape
+    take the episode-level simulation (actions/fast_order.py, ~10x
+    cheaper than the replay at 50k tasks); anything else falls back to
+    the exact replay below.  tests/test_fast_order.py pins the two
+    orders equal."""
+    from volcano_tpu.actions.fast_order import try_compute_task_order
+
+    fast = try_compute_task_order(ssn)
+    if fast is not None:
+        return fast
+    return compute_task_order_replay(ssn)
+
+
+def compute_task_order_replay(ssn: Session) -> List[TaskInfo]:
+    """Replay the loop assuming every task places, recording pop
     order; then unwind all accounting (reverse order, like
     Statement.Discard)."""
     order: List[TaskInfo] = []
@@ -95,8 +111,8 @@ class JaxAllocateAction(Action):
 
     def _kernel_proposals(
         self, ssn: Session, ordered_tasks: List[TaskInfo]
-    ) -> Dict[str, str]:
-        """Pack + run the device kernel; {task uid → node name}.
+    ) -> Tuple[Dict[str, str], Optional[object]]:
+        """Pack + run the device kernel; ({task uid → node name}, snap).
 
         Tasks flagged ``task_has_preferences`` are excluded — the kernel
         has no lanes for preferred (anti-)affinity scores, so those route
@@ -113,7 +129,7 @@ class JaxAllocateAction(Action):
                 jobs[job.uid] = job
         nodes = [ssn.nodes[name] for name in sorted(ssn.nodes)]
         if not nodes or not ordered_tasks:
-            return {}
+            return {}, None
 
         t0 = time.perf_counter()
         snap = pack_session(
@@ -134,7 +150,7 @@ class JaxAllocateAction(Action):
         for i, task in enumerate(ordered_tasks):
             if assignment[i] >= 0 and not snap.task_has_preferences[i]:
                 proposals[task.uid] = nodes[assignment[i]].name
-        return proposals
+        return proposals, snap
 
     # ---- phase 3 ----
 
@@ -142,7 +158,15 @@ class JaxAllocateAction(Action):
         ordered = compute_task_order(ssn)
         if not ordered:
             return
-        proposals = self._kernel_proposals(ssn, ordered)
+        proposals, snap = self._kernel_proposals(ssn, ordered)
+
+        # Fully-placed exact sessions commit in bulk (actions/fast_apply);
+        # anything outside that envelope runs the loop below.
+        if snap is not None:
+            from volcano_tpu.actions.fast_apply import try_fast_apply
+
+            if try_fast_apply(ssn, ordered, proposals, snap):
+                return
 
         predicate_fn = make_predicate_fn(ssn)
         host_choose = host_node_chooser(ssn)

@@ -225,10 +225,25 @@ def pack_session(
     snap.job_ready_count = np.zeros(J_pad, dtype=np.int32)
     snap.task_has_preferences = np.zeros(T_pad, dtype=bool)
 
+    # Resource lanes: bulk-extract cpu/memory (the dominant cost at 50k
+    # tasks was one tiny np array per task); scalar lanes stay per-task
+    # but only exist when the session carries extended resources.
+    if T:
+        snap.task_resreq[:T, 0] = [t.init_resreq.milli_cpu for t in tasks]
+        mem = np.array([t.init_resreq.memory for t in tasks], dtype=np.float64)
+        if (mem % MIB).any():
+            snap.memory_exact = False
+        snap.task_resreq[:T, 1] = mem / MIB
+        snap.task_job[:T] = [job_index.get(t.job, 0) for t in tasks]
+        if R > 2:
+            for i, t in enumerate(tasks):
+                sc = t.init_resreq.scalars
+                if sc:
+                    for r, name in enumerate(names[2:], start=2):
+                        snap.task_resreq[i, r] = sc.get(name, 0.0)
+
     # Tasks: selector/affinity/toleration bits come from the pod spec.
     for i, t in enumerate(tasks):
-        snap.task_resreq[i] = _res_vec(t.init_resreq, names, snap)
-        snap.task_job[i] = job_index.get(t.job, 0)
         snap.task_uids.append(t.uid)
         pod = t.pod
         if pod is None:
@@ -278,11 +293,26 @@ def pack_session(
             # the kernel has no lanes for them — route to host path.
             snap.task_has_preferences[i] = True
 
-    # Nodes.
+    # Nodes: same bulk lane extraction as tasks.
+    if N:
+        for arr, field_name in (
+            (snap.node_idle, "idle"),
+            (snap.node_used, "used"),
+            (snap.node_alloc, "allocatable"),
+        ):
+            res_list = [getattr(n, field_name) for n in nodes]
+            arr[:N, 0] = [r.milli_cpu for r in res_list]
+            mem = np.array([r.memory for r in res_list], dtype=np.float64)
+            if (mem % MIB).any():
+                snap.memory_exact = False
+            arr[:N, 1] = mem / MIB
+            if R > 2:
+                for i, r in enumerate(res_list):
+                    if r.scalars:
+                        for k, name in enumerate(names[2:], start=2):
+                            arr[i, k] = r.scalars.get(name, 0.0)
+
     for i, n in enumerate(nodes):
-        snap.node_idle[i] = _res_vec(n.idle, names, snap)
-        snap.node_used[i] = _res_vec(n.used, names, snap)
-        snap.node_alloc[i] = _res_vec(n.allocatable, names, snap)
         snap.node_ok[i] = n.ready() and not (
             n.node is not None and n.node.spec.unschedulable
         )
