@@ -226,6 +226,114 @@ def bench_preempt_config(name: str, kwargs: dict, iters: int = 5) -> dict:
     }
 
 
+class _ListBinder:
+    """Minimal binder for the action bench (tests/fakes.py lives outside
+    the package)."""
+
+    def __init__(self):
+        self.binds = []
+
+    def bind(self, task, hostname):
+        self.binds.append((f"{task.namespace}/{task.name}", hostname))
+
+
+def bench_action(name: str, kwargs: dict, iters: int = 3) -> dict:
+    """The REAL jax-allocate action through a live Session: cache feed →
+    open → ORDER/KERNEL/APPLY → bindings through the cache.  This is the
+    number the kernel-only configs cannot show — the whole framework's
+    session latency, host machinery included (VERDICT r4 item 1).
+
+    ``value`` is the action execute() wall time (the reference's
+    action-latency metric measures the same span,
+    pkg/scheduler/metrics/metrics.go:56-63); session open (the snapshot
+    deep copy, cache.go:712-790's analogue) is reported alongside.  The
+    native baseline is the C++ 16-thread loop on the identical packed
+    session — the stand-in for the reference's in-action hot loop."""
+    import volcano_tpu.actions  # noqa: F401 — registers actions
+    import volcano_tpu.plugins  # noqa: F401 — registers plugin builders
+    from volcano_tpu import native
+    from volcano_tpu.actions.jax_allocate import JaxAllocateAction, compute_task_order
+    from volcano_tpu.cache import SchedulerCache
+    from volcano_tpu.conf import PluginOption, Tier
+    from volcano_tpu.framework import close_session, open_session
+    from volcano_tpu.ops.packing import pack_session
+    from volcano_tpu.ops.synthetic import generate_cluster_objects
+
+    nodes, pods, pgs, queues = generate_cluster_objects(**kwargs)
+    tier_conf = [
+        Tier(plugins=[PluginOption(name=n) for n in ("priority", "gang")]),
+        Tier(plugins=[
+            PluginOption(name=n)
+            for n in ("drf", "predicates", "proportion", "nodeorder", "binpack")
+        ]),
+    ]
+
+    def fresh_cache():
+        cache = SchedulerCache(binder=_ListBinder())
+        for n in nodes:
+            cache.add_node(n)
+        for p in pods:
+            cache.add_pod(p)
+        for pg in pgs:
+            cache.add_pod_group(pg)
+        for q in queues:
+            cache.add_queue(q)
+        return cache
+
+    action = JaxAllocateAction()
+    open_times, exec_times = [], []
+    binds = 0
+    baseline_s = None
+    for it in range(iters + 1):  # first iteration is the compile warmup
+        cache = fresh_cache()
+        t0 = time.perf_counter()
+        ssn = open_session(cache, tier_conf, [])
+        t1 = time.perf_counter()
+        if it == 0:
+            # native baseline on the identical packed session
+            ordered = compute_task_order(ssn)
+            jobs = {}
+            for t in ordered:
+                job = ssn.jobs.get(t.job)
+                if job is not None and job.uid not in jobs:
+                    jobs[job.uid] = job
+            snap = pack_session(
+                ordered, list(jobs.values()),
+                [ssn.nodes[n] for n in sorted(ssn.nodes)],
+            )
+            try:
+                baseline_s = min(
+                    _time(lambda: native.baseline_allocate(snap, n_threads=1),
+                          warmup=0, iters=1),
+                    _time(lambda: native.baseline_allocate(snap, n_threads=16),
+                          warmup=0, iters=1),
+                )
+            except RuntimeError:
+                baseline_s = None
+        t1 = time.perf_counter()
+        action.execute(ssn)
+        t2 = time.perf_counter()
+        close_session(ssn)
+        if it > 0:
+            open_times.append(t1 - t0)
+            exec_times.append(t2 - t1)
+        binds = len(cache.binder.binds)
+
+    action_s = float(np.median(exec_times))
+    return {
+        "metric": f"action_latency_{name}",
+        "value": round(action_s * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(baseline_s / action_s, 2) if baseline_s else None,
+        "baseline_ms": round(baseline_s * 1e3, 3) if baseline_s else None,
+        "session_open_ms": round(float(np.median(open_times)) * 1e3, 3),
+        "pods_per_sec": round(binds / action_s),
+        "binds": binds,
+        "tasks": kwargs["n_tasks"],
+        "nodes": kwargs["n_nodes"],
+    }
+
+
 def run_equivalence_check() -> int:
     """--check: compiled-backend equivalence gates (ADVICE r2: the
     compiled Mosaic path needs coverage beyond interpret mode — this
@@ -325,6 +433,17 @@ def main() -> int:
         else bench_config(name, kw)
         for name, kw in configs.items()
     ]
+
+    # Full-framework action latency at the headline shape (real Session,
+    # host machinery included) — reported on stderr and folded into the
+    # headline line so BENCH consumers see both numbers.
+    if headline in configs:
+        action = bench_action(headline, BASELINE_CONFIGS[headline])
+        print(json.dumps(action), file=sys.stderr)
+        results[-1]["action_ms"] = action["value"]
+        results[-1]["action_vs_baseline"] = action["vs_baseline"]
+        results[-1]["action_session_open_ms"] = action["session_open_ms"]
+
     for r in results[:-1]:
         print(json.dumps(r), file=sys.stderr)
     print(json.dumps(results[-1]))

@@ -58,7 +58,7 @@ order_s = time.perf_counter() - t0
 
 action = JaxAllocateAction()
 t0 = time.perf_counter()
-proposals = action._kernel_proposals(ssn, order)
+proposals, _snap = action._kernel_proposals(ssn, order)
 kernel_s = time.perf_counter() - t0
 
 t0 = time.perf_counter()

@@ -70,6 +70,31 @@ class _LaneAcc:
             res.scalars = self.scalars
 
 
+def _acc_alloc(a0: _LaneAcc, a1: _LaneAcc, rr) -> None:
+    """Statement.allocate's job-lane ops: allocated +r; total -r,+r
+    (update_task_status Pending→Allocated = delete_task_info +
+    add_task_info)."""
+    a0.cpu += rr.milli_cpu
+    a0.mem += rr.memory
+    a1.cpu = (a1.cpu - rr.milli_cpu) + rr.milli_cpu
+    a1.mem = (a1.mem - rr.memory) + rr.memory
+    if rr.scalars:
+        _seq_add_scalars(a0, rr.scalars, (1,))
+        _seq_add_scalars(a1, rr.scalars, (-1, 1))
+
+
+def _acc_commit(a0: _LaneAcc, a1: _LaneAcc, rr) -> None:
+    """Commit's job-lane ops: allocated -r,+r; total -r,+r
+    (update_task_status Allocated→Binding — both allocated statuses)."""
+    a0.cpu = (a0.cpu - rr.milli_cpu) + rr.milli_cpu
+    a0.mem = (a0.mem - rr.memory) + rr.memory
+    a1.cpu = (a1.cpu - rr.milli_cpu) + rr.milli_cpu
+    a1.mem = (a1.mem - rr.memory) + rr.memory
+    if rr.scalars:
+        _seq_add_scalars(a0, rr.scalars, (-1, 1))
+        _seq_add_scalars(a1, rr.scalars, (-1, 1))
+
+
 def _seq_add_scalars(acc: _LaneAcc, scalars, pattern) -> None:
     """Apply +v/-v in ``pattern`` order per scalar lane (float
     non-associativity means x+v-v+v != x+v in general — the sequence must
@@ -143,6 +168,7 @@ def try_fast_apply(
 
     # ---- single pass over ordered tasks ----
     job_accs: Dict[str, tuple] = {}
+    job_ready0: Dict[str, int] = {}
     node_rows: Dict[str, list] = {}
     drf_accs: Dict[str, _LaneAcc] = {}
     ns_accs: Dict[str, _LaneAcc] = {}
@@ -162,16 +188,7 @@ def try_fast_apply(
         if acc is None:
             acc = (_LaneAcc(job.allocated), _LaneAcc(job.total_request), job, [])
             job_accs[job.uid] = acc
-        # allocate: alloc +r, total -r +r;  commit: alloc -r +r, total -r +r
-        # (left-associative chains preserve the slow path's IEEE sequence)
-        a0, a1 = acc[0], acc[1]
-        a0.cpu = ((a0.cpu + rc) - rc) + rc
-        a0.mem = ((a0.mem + rm) - rm) + rm
-        a1.cpu = (((a1.cpu - rc) + rc) - rc) + rc
-        a1.mem = (((a1.mem - rm) + rm) - rm) + rm
-        if scal:
-            _seq_add_scalars(a0, scal, (1, -1, 1))
-            _seq_add_scalars(a1, scal, (-1, 1, -1, 1))
+            job_ready0[job.uid] = job.ready_task_num()
         acc[3].append(t)
 
         rows = node_rows.get(host)
@@ -240,7 +257,26 @@ def try_fast_apply(
         idle.store(node.idle)
         used.store(node.used)
 
+    gang_ready = bool(ready_chain)
     for alloc_acc, total_acc, job, tasks in job_accs.values():
+        # job.allocated/total_request follow the slow path's EPISODE
+        # structure: the first episode feeds until gang-ready (all its
+        # Statement.allocate ops, then all its commit ops), later episodes
+        # are one task each.  Per-lane op order must match for IEEE
+        # bit-identity — per-task interleave rounds differently on lanes
+        # with non-exact values.
+        ready0 = job_ready0[job.uid]
+        k1 = 1
+        if gang_ready and ready0 < job.min_available:
+            k1 = min(max(job.min_available - ready0, 1), len(tasks))
+        first, rest = tasks[:k1], tasks[k1:]
+        for t in first:  # episode-1 allocates
+            _acc_alloc(alloc_acc, total_acc, t.resreq)
+        for t in first:  # episode-1 commits
+            _acc_commit(alloc_acc, total_acc, t.resreq)
+        for t in rest:  # single-task episodes
+            _acc_alloc(alloc_acc, total_acc, t.resreq)
+            _acc_commit(alloc_acc, total_acc, t.resreq)
         alloc_acc.store(job.allocated)
         total_acc.store(job.total_request)
         jtasks = job.tasks

@@ -95,19 +95,20 @@ class TaskInfo:
         return self.resreq.is_empty()
 
     def clone(self) -> "TaskInfo":
-        t = TaskInfo(
-            self.uid,
-            self.job,
-            self.name,
-            self.namespace,
-            self.resreq.clone(),
-            self.init_resreq.clone(),
-            self.node_name,
-            self.status,
-            self.priority,
-            self.pod,
-        )
+        # __new__ bypass — two clones per placement (statement/node copy
+        # + cache bind copy) put this on the session hot path.
+        t = TaskInfo.__new__(TaskInfo)
+        t.uid = self.uid
+        t.job = self.job
+        t.name = self.name
+        t.namespace = self.namespace
+        t.resreq = self.resreq.clone()
+        t.init_resreq = self.init_resreq.clone()
+        t.node_name = self.node_name
+        t.status = self.status
+        t.priority = self.priority
         t.volume_ready = self.volume_ready
+        t.pod = self.pod
         return t
 
     @property

@@ -67,7 +67,15 @@ class Resource:
         return r
 
     def clone(self) -> "Resource":
-        return Resource(self.milli_cpu, self.memory, dict(self.scalars), self.max_task_num)
+        # __new__ bypass: clone is on the per-task hot path (two clones
+        # per placement via TaskInfo.clone) and __init__'s defensive
+        # float()/dict() coercions double its cost on already-valid state.
+        r = Resource.__new__(Resource)
+        r.milli_cpu = self.milli_cpu
+        r.memory = self.memory
+        r.scalars = dict(self.scalars)
+        r.max_task_num = self.max_task_num
+        return r
 
     # ---- predicates ----
 
