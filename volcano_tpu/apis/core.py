@@ -261,8 +261,10 @@ class NetworkPolicy(K8sObject):
 @dataclass
 class Event(K8sObject):
     """Kubernetes Event — the user-facing audit trail.  ``count``
-    aggregates repeats of the same (object, reason, message), as the
-    k8s event recorder's correlator does."""
+    aggregates repeats of the same (object, type, reason) — the message
+    is deliberately NOT part of the aggregation key, matching the k8s
+    correlator, so variable-detail repeats collapse into one Event
+    (whose message refreshes to the latest occurrence)."""
 
     involved_object: Dict[str, str] = field(default_factory=dict)
     type: str = "Normal"

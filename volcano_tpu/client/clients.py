@@ -332,19 +332,25 @@ class SchedulerClient:
     ) -> core.Event:
         """Event recorder (the scheduler's user-facing audit trail —
         cache.go:304-306 eventBroadcaster + :600-610, 832-867 call
-        sites).  Repeats of the same (object, reason, message) aggregate
-        into one Event with a bumped ``count`` — the k8s correlator
-        behavior — so a stuck pending job cannot grow the store
-        unboundedly across scheduling cycles."""
+        sites).  Repeats of the same (object, type, reason) aggregate
+        into one Event with a bumped ``count`` — the k8s correlator's
+        aggregation key excludes the message precisely so that
+        variable-detail repeats (\"failed to bind to n1: ...\", \"... n2:
+        ...\") cannot mint unbounded distinct Events for one stuck
+        object across scheduling cycles."""
         import hashlib
 
         digest = hashlib.sha1(
-            f"{involved.get('kind')}/{involved.get('name')}|{reason}|{message}".encode()
+            f"{involved.get('kind')}/{involved.get('name')}|{type_}|{reason}".encode()
         ).hexdigest()[:10]
         name = f"{involved.get('name', 'obj')}.{digest}"
         existing = self.api.get("Event", namespace, name)
         if existing is not None:
             existing.count += 1
+            # refresh to the latest occurrence's detail, like the k8s
+            # correlator — operators act on the current cause, not the
+            # first-seen one
+            existing.message = message
             return self.api.update(existing)
         return self.kube.create_event(
             core.Event(

@@ -29,10 +29,13 @@ class AdmissionDaemon:
         gate_pods: bool = False,
         listen_host: str = "127.0.0.1",
         listen_port: int = 0,
+        debug_enabled: bool = False,
     ):
         self.api = api
         register_webhooks(api, gate_pods=gate_pods)
-        self.serving = ServingServer(host=listen_host, port=listen_port)
+        self.serving = ServingServer(
+            host=listen_host, port=listen_port, debug_enabled=debug_enabled
+        )
 
     def start(self) -> "AdmissionDaemon":
         self.serving.start()

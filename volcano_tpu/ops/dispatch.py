@@ -69,6 +69,31 @@ def select_executor(
     return "blocked"
 
 
+def preempt_f32_exact(pk) -> bool:
+    """f32 exactness for the PREEMPT arrays: base node planes AND the
+    preempt-specific lanes the kernel arithmetics on.  Gating on
+    ``pk.base`` alone (ADVICE r3) missed sessions whose victims or
+    future-idle exceed the floor-division envelope while node_alloc does
+    not (e.g. releasing pods inflating future_idle).  The bound must hold
+    for the ACCUMULATED plane — the kernel adds evicted victims' resreqs
+    back into future-idle, so the worst case per node is
+    fi0 + sum(victim resreqs on that node), not any single element."""
+    import numpy as np
+
+    from volcano_tpu.ops.kernels import MAX_PRIORITY
+
+    limit = 2**24 / MAX_PRIORITY
+    if not f32_lr_exact(pk.base):
+        return False
+    nv = max(pk.n_victims, 0)
+    worst = pk.node_fi0[:, :2].astype(np.float64).copy()
+    if nv:
+        vic_node = pk.vic_node[:nv]
+        np.add.at(worst[:, 0], vic_node, pk.vic_resreq[:nv, 0].astype(np.float64))
+        np.add.at(worst[:, 1], vic_node, pk.vic_resreq[:nv, 1].astype(np.float64))
+    return float(worst.max(initial=0.0)) < limit
+
+
 def select_preempt_executor(pk) -> str:
     """Executor for the preempt pass: 'pallas' | 'dense'.  Same decision
     shape as select_executor — pallas only on TPU, inside the f32
@@ -78,7 +103,7 @@ def select_preempt_executor(pk) -> str:
     area = max(base.n_tasks, 1) * max(base.n_nodes, 1)
     if area < _SMALL_AREA:
         return "dense"
-    if f32_lr_exact(base) and _tpu_available():
+    if preempt_f32_exact(pk) and _tpu_available():
         from volcano_tpu.ops.preempt_pallas import (
             preempt_smem_bytes,
             preempt_vmem_bytes,

@@ -221,6 +221,17 @@ def pack_preempt_session(ssn) -> PreemptPacked:
     # the uid-sorted preemptee list (preempt.py victims_queue)
     from volcano_tpu.plugins.conformance import _is_critical
 
+    # Frozen-order soundness guard (mirrors reclaim_pack): phase 1 pops
+    # starving jobs from a LIVE PriorityQueue, so evicting a victim whose
+    # job is ITSELF starving flips that job's DRF share / gang readiness
+    # and can reorder it against other still-unprocessed starving jobs in
+    # the same queue.  The pack-time frozen order cannot observe that —
+    # refuse such sessions (host fallback).  With a single starving job
+    # in the victim job's queue there is no order to disturb.
+    starving_uids = {
+        job.uid: quid for quid, jobs_ in starving.items() for job in jobs_
+    }
+
     vics = []
     for n in nodes:
         node_vics = [
@@ -232,6 +243,14 @@ def pack_preempt_session(ssn) -> PreemptPacked:
             # never enter the dense/device victim set (conformance.go:45-60)
             and not _is_critical(t)
         ]
+        for t in node_vics:
+            vquid = starving_uids.get(t.job)
+            if vquid is not None and len(starving.get(vquid, [])) >= 2:
+                raise ValueError(
+                    f"job {t.job} is both starving preemptor and victim "
+                    "source in a multi-job queue; frozen order replay "
+                    "would diverge"
+                )
         node_vics = _order_stable(
             node_vics, lambda l, r: ssn.task_order_fn(r, l)
         )

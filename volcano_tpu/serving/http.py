@@ -43,7 +43,18 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/debug/stacks":
             # the pprof-goroutine analogue (cmd/scheduler/main.go:25
             # imports net/http/pprof): live thread stacks for hang
-            # forensics
+            # forensics.  Stack dumps leak internals (paths, job names,
+            # lock state), so off-loopback binds must opt in explicitly
+            # via debug_enabled — a metrics port exposed cluster-wide
+            # must not also expose forensics.
+            if not debug_allowed(
+                getattr(self.server, "debug_enabled", False),
+                self.client_address[0],
+            ):
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
             import sys
             import threading
             import traceback
@@ -71,6 +82,12 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
 
+def debug_allowed(debug_enabled: bool, client_ip: str) -> bool:
+    """/debug/stacks policy: loopback always, anything else only with
+    the explicit opt-in."""
+    return debug_enabled or client_ip in ("127.0.0.1", "::1")
+
+
 class ServingServer:
     """Threaded healthz+metrics server.  ``port=0`` binds an ephemeral
     port (read it back from ``.port`` after start)."""
@@ -81,6 +98,7 @@ class ServingServer:
         port: int = 0,
         registry=None,
         health_check=None,
+        debug_enabled: bool = False,
     ):
         self._host = host
         self._port = port
@@ -88,6 +106,8 @@ class ServingServer:
         #: optional () -> bool; False turns /healthz into a 503 (liveness
         #: must reflect the daemon's loop, not just the process)
         self._health_check = health_check
+        #: serve /debug/stacks to non-loopback clients (off by default)
+        self._debug_enabled = debug_enabled
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -100,6 +120,7 @@ class ServingServer:
         self._httpd = ThreadingHTTPServer((self._host, self._port), _Handler)
         self._httpd.registry = self._registry
         self._httpd.health_check = self._health_check
+        self._httpd.debug_enabled = self._debug_enabled
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="vtpu-serving", daemon=True
         )

@@ -99,7 +99,13 @@ class LeaderElector:
             return False
 
     def _try_acquire_or_renew(self) -> bool:
-        now = time.monotonic()
+        # Wall clock, NOT time.monotonic(): renewTime is written by one
+        # candidate and compared by others, and monotonic clocks have
+        # process-local epochs — a standby reading a leader's monotonic
+        # timestamp judges expiry against garbage.  Wall time matches the
+        # reference's leaderelection RenewTime semantics (clock-skew
+        # bounded by leaseDuration, as upstream documents).
+        now = time.time()
         cm, rec = self._read()
         holder = rec.get("holderIdentity") if rec else None
         renew = float(rec.get("renewTime", 0.0)) if rec else 0.0
