@@ -399,7 +399,7 @@ def test_jax_preempt_action_starving_victim_fallback():
     ssn = open_session(cache, FULL_TIERS, [])
     JaxPreemptAction().execute(ssn)  # must not raise
     jax_pipe = {
-        t.uid: t.node_name
+        f"{t.namespace}/{t.name}": t.node_name
         for job in ssn.jobs.values()
         for t in job.task_status_index.get(TaskStatus.Pipelined, {}).values()
     }
@@ -409,16 +409,14 @@ def test_jax_preempt_action_starving_victim_fallback():
     hssn = open_session(host_cache, FULL_TIERS, [])
     PreemptAction().execute(hssn)
     host_pipe = {
-        t.uid: t.node_name
+        f"{t.namespace}/{t.name}": t.node_name
         for job in hssn.jobs.values()
         for t in job.task_status_index.get(TaskStatus.Pipelined, {}).values()
     }
     close_session(hssn)
 
     assert set(cache.evictor.evicts) == set(host_cache.evictor.evicts)
-    # uids differ between the two cache builds (global counters), so
-    # compare by (name -> node) via the session task names instead
-    assert len(jax_pipe) == len(host_pipe)
+    assert jax_pipe == host_pipe
 
 
 def test_preempt_f32_gate_covers_victims_and_future_idle():
@@ -437,3 +435,34 @@ def test_preempt_f32_gate_covers_victims_and_future_idle():
     assert preempt_f32_exact(pk)
     pk.node_fi0[0, 0] = big
     assert not preempt_f32_exact(pk)
+
+
+def test_sensitive_gang_allowance_flips_mid_pass():
+    """A victim job with 1 < minAvailable < running-count loses victims
+    until the gang floor, then its remaining victims become protected —
+    the allowance refresh fires mid-pass and must invalidate the
+    kernel's cached masked plane (identical gang-replica preemptor rows
+    keep the incremental fast path active around the flip)."""
+    nodes = [build_node(f"n{i:03d}", {"cpu": "4", "memory": "8G"})
+             for i in range(4)]
+    pods, pgs = [], []
+    queues = [build_queue("q1", weight=1)]
+    # victim job: 4 running tasks, minAvailable 2 -> exactly 2 evictable
+    pgs.append(build_pod_group("ns", "vic", 2, queue="q1"))
+    for i in range(4):
+        pods.append(build_pod("ns", f"vic-r{i}", f"n{i:03d}",
+                              {"cpu": "3", "memory": "3G"},
+                              phase="Running", group="vic", priority=0))
+    # preemptor gang: 4 identical tasks (fast-path rows) wanting 3 nodes
+    pgs.append(build_pod_group("ns", "pre", 2, queue="q1",
+                               priority_class_name="high"))
+    for i in range(4):
+        pods.append(build_pod("ns", f"pre-{i}", "",
+                              {"cpu": "2", "memory": "2G"},
+                              group="pre", priority=100))
+    cache = make_cache(
+        nodes=nodes, pods=pods, pod_groups=pgs, queues=queues,
+        priority_classes=[build_priority_class("high", 100)],
+    )
+    host_ev, host_pipe = _assert_case(cache)
+    assert len(host_ev) == 2, host_ev  # gang floor protects the other two

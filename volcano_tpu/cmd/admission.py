@@ -56,6 +56,7 @@ def main(argv=None) -> int:
         gate_pods=args.gate_pods,
         listen_host=args.listen_host,
         listen_port=args.listen_port,
+        debug_enabled=args.enable_debug_stacks,
     )
     daemon.start()
     try:

@@ -57,6 +57,7 @@ def main(argv=None) -> int:
             listen_port=args.listen_port,
             leader_elect=args.leader_elect,
             identity=args.leader_elect_id,
+            debug_enabled=args.enable_debug_stacks,
         )
     )
 

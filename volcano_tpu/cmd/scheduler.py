@@ -50,6 +50,11 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--listen-port", type=int, default=8080)
     parser.add_argument("--leader-elect", action="store_true")
     parser.add_argument("--leader-elect-id", default=None)
+    parser.add_argument(
+        "--enable-debug-stacks", action="store_true",
+        help="serve /debug/stacks to non-loopback clients (forensics; "
+        "stack dumps expose internals — default loopback-only)",
+    )
 
 
 def main(argv=None) -> int:
@@ -70,6 +75,7 @@ def main(argv=None) -> int:
             listen_port=args.listen_port,
             leader_elect=args.leader_elect,
             identity=args.leader_elect_id,
+            debug_enabled=args.enable_debug_stacks,
         )
     )
 

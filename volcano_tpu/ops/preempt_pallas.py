@@ -22,6 +22,22 @@ preempt.go:45-276) inside one ``pallas_call``:
 
 Slot kinds: 0 BEGIN1, 1 ATTEMPT1, 2 END1, 5 BURN2, 9 pad.
 
+Incremental repeated-row fast path (the round-4 allocate-kernel design,
+ported): a successful attempt mutates node state at ONE node column
+(evictions + the pipeline all land on the chosen node), so when attempt
+k shares its (job, resreq row) with attempt k-1 — gang replicas are
+schedule-contiguous and submit identical rows — the masked
+validity+score plane is unchanged except in the [1, 128] sublane row
+holding the previous pick.  The kernel keeps that plane in VMEM scratch
+and recomputes only the dirty row.  The one non-local mutation is the
+gang-allowance refresh after an eviction (it touches the victim job's
+slots on EVERY node): a host-precomputed per-slot sensitivity flag
+(``vsens`` — victim's job has an allowance that can actually change,
+i.e. any sibling victim with min_available != 1) turns that into a
+single row op; a sensitive eviction or a statement rollback invalidates
+the cached plane and the next attempt recomputes in full.  Results are
+bit-identical to the full recompute (same elementwise formulas).
+
 Phase 2 (the under-request intra-job sweep, preempt.go:146-175)
 compiles to a single BURN slot per (queue, job): under the supported
 preemptable tier ({priority, gang, conformance} — enforced by
@@ -110,6 +126,10 @@ def _make_preempt_kernel(
         vjp_ref,  # VMEM [K, NS, 128] i32 — victim job priority
         vjmin_ref,  # VMEM [K, NS, 128] f32 — victim job min_available
         vinit_ref,  # VMEM [2*K, NS, 128] f32 — galw0 | alive0
+        vsens_ref,  # VMEM [K, NS, 128] f32 — evicting this victim can
+        #           change a gang allowance somewhere (job has a sibling
+        #           victim with min_available != 1) → invalidates the
+        #           cached masked plane
         jobsf_ref,  # VMEM [2, JS, 128] f32 — ready0, waiting0
         jobsmem_ref,  # SMEM [3*JPAD] i32 — cursor0 | jqueue | jprio (flat)
         minav_ref,  # SMEM [JPAD] f32 — min_available as scalars
@@ -126,6 +146,9 @@ def _make_preempt_kernel(
         #           scalar state (the host PQ pops have no undo)
         pipe_s,  # scratch [PS, 128] i32
         spre_s,  # scratch [SC_pad, NS, 128] f32 — per-class score planes
+        masked_s,  # scratch [NS, 128] f32 — cached masked plane
+        ctrl_s,  # SMEM scratch [5] i32 — valid, prev_job, prev_cls,
+        #          prev_scl, dirty node (-1 = clean)
         fi_sh,  # shadow [R, NS, 128]
         ncnt_sh,  # shadow [1, NS, 128]
         alive_sh,  # shadow [K, NS, 128]
@@ -154,6 +177,8 @@ def _make_preempt_kernel(
 
             jax.lax.fori_loop(0, JS * LANES, _cp, 0)
             pipe_s[:] = jnp.full((PS, LANES), -1, jnp.int32)
+            ctrl_s[0] = 0
+            ctrl_s[4] = -1
             # precompute the static per-class score planes
             if SC:
                 sc_lane = jax.lax.broadcasted_iota(jnp.int32, (1, R), 1)
@@ -174,7 +199,6 @@ def _make_preempt_kernel(
                         shape,
                     )
 
-        nmax = naux_ref[1]
         idxp = (
             jax.lax.broadcasted_iota(jnp.int32, shape, 0) * LANES
             + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
@@ -182,10 +206,6 @@ def _make_preempt_kernel(
         jidx = (
             jax.lax.broadcasted_iota(jnp.int32, (JS, LANES), 0) * LANES
             + jax.lax.broadcasted_iota(jnp.int32, (JS, LANES), 1)
-        )
-        pidx = (
-            jax.lax.broadcasted_iota(jnp.int32, (PS, LANES), 0) * LANES
-            + jax.lax.broadcasted_iota(jnp.int32, (PS, LANES), 1)
         )
         row_lane = jax.lax.broadcasted_iota(jnp.int32, (1, R + 2), 1)
 
@@ -228,11 +248,20 @@ def _make_preempt_kernel(
             ready_s[:] = ready_sh[:]
             wait_s[:] = wait_sh[:]
             pipe_s[:] = pipe_sh[:]
+            ctrl_s[0] = 0  # rolled-back state invalidates the cached plane
+
+        lane1 = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
 
         def attempt(j, p, inter):
             """One _preempt try (preempt.go:181-259) for preemptor task p
             of job j.  ``inter``: phase-1 cross-job filter (same queue,
-            different job) vs phase-2 intra-job filter."""
+            different job) vs phase-2 intra-job filter.
+
+            The masked validity+score plane lives in ``masked_s``: a full
+            recompute happens only when the cached plane cannot be
+            reused (different job/resreq row, or invalidated by rollback
+            / a sensitive gang refresh); otherwise only the sublane row
+            dirtied by the previous attempt is recomputed."""
             trow = ptask_ref[pl.ds(p, 1), :]  # [1, R+2]
 
             def col(r):
@@ -240,96 +269,147 @@ def _make_preempt_kernel(
 
             rr = [col(r) for r in range(R)]
             cls = col(R).astype(jnp.int32)
+            scl = col(R + 1).astype(jnp.int32)
             pprio = jprio_of(j)
+            jq = jqueue_of(j)
 
-            # victim eligibility per slot k: alive ∩ gang allowance ∩
-            # strictly-lower job priority ∩ the phase's job/queue filter.
-            # Fixed at attempt start — mid-attempt evictions don't re-rank
-            # (matches the host: victims list snapshot per node).
-            elig = []
-            for k in range(K):
-                e = (alive_s[k] > 0.0) & (galw_s[k] > 0.0) & (vjp_ref[k] < pprio)
-                if inter:
-                    e = e & (vq_ref[k] == jqueue_of(j)) & (vjob_ref[k] != j)
-                else:
-                    e = e & (vjob_ref[k] == j)
-                elig.append(e)
-
-            # per-node eligible-victim sums + counts
-            vsum = []
-            for r in range(R):
-                acc = None
-                for k in range(K):
-                    term = jnp.where(elig[k], vr_ref[r * K + k], 0.0)
-                    acc = term if acc is None else acc + term
-                vsum.append(acc)
-            vcnt = None
-            for k in range(K):
-                t = jnp.where(elig[k], 1.0, 0.0)
-                vcnt = t if vcnt is None else vcnt + t
-
-            # validation (preempt.go:261-276): victims exist + pod-count
-            # headroom + resreq fits future_idle + all eligible victims
-            okl = None
-            for r in range(R):
-                lane_ok = rr[r] < fi_s[r] + vsum[r] + tol_ref[0, r]
-                if r >= 2:
-                    lane_ok = lane_ok | (rr[r] <= tol_ref[0, r])
-                okl = lane_ok if okl is None else okl & lane_ok
-            valid = (
-                (cf_ref[cls] > 0.0)
-                & (ncnt_s[0] < nmax)
-                & (vcnt > 0.0)
-                & okl
-            )
-
-            # node scores at static used: precomputed per-class plane,
-            # or inline when the class count exceeded the cap (SC == 0)
-            if SC:
-                scl = col(R + 1).astype(jnp.int32)
-                total = spre_s[scl]
-            else:
-                req = [rr[r] + used_ref[r] for r in range(R)]
-                total = score_planes(
-                    rr,
-                    req,
-                    lambda r: alloc_ref[r],
-                    lambda r: maxal_ref[r],
-                    lambda r: allocpos_ref[r],
-                    weights,
-                    shape,
+            def elig_view(k, rowslice):
+                """Victim eligibility per slot k over a row view: alive ∩
+                gang allowance ∩ strictly-lower job priority ∩ the
+                phase's job/queue filter.  Fixed at attempt start —
+                mid-attempt evictions don't re-rank (matches the host:
+                victims list snapshot per node)."""
+                e = (
+                    (rowslice(alive_s, k) > 0.0)
+                    & (rowslice(galw_s, k) > 0.0)
+                    & (rowslice(vjp_ref, k) < pprio)
                 )
-            masked = jnp.where(valid, total, -jnp.inf)
+                if inter:
+                    e = e & (rowslice(vq_ref, k) == jq) & (
+                        rowslice(vjob_ref, k) != j
+                    )
+                else:
+                    e = e & (rowslice(vjob_ref, k) == j)
+                return e
+
+            def masked_rows(rowslice):
+                """Masked validity+score over a row view ([NS|1, 128]) —
+                the single copy of the validation arithmetic
+                (preempt.go:261-276): victims exist + pod-count headroom
+                + resreq fits future_idle + all eligible victims."""
+                elig = [elig_view(k, rowslice) for k in range(K)]
+                vsum = []
+                for r in range(R):
+                    acc = None
+                    for k in range(K):
+                        term = jnp.where(elig[k], rowslice(vr_ref, r * K + k), 0.0)
+                        acc = term if acc is None else acc + term
+                    vsum.append(acc)
+                vcnt = None
+                for k in range(K):
+                    t = jnp.where(elig[k], 1.0, 0.0)
+                    vcnt = t if vcnt is None else vcnt + t
+                okl = None
+                for r in range(R):
+                    lane_ok = rr[r] < rowslice(fi_s, r) + vsum[r] + tol_ref[0, r]
+                    if r >= 2:
+                        lane_ok = lane_ok | (rr[r] <= tol_ref[0, r])
+                    okl = lane_ok if okl is None else okl & lane_ok
+                valid = (
+                    (rowslice(cf_ref, cls) > 0.0)
+                    & (rowslice(ncnt_s, 0) < rowslice(naux_ref, 1))
+                    & (vcnt > 0.0)
+                    & okl
+                )
+                # node scores at static used: precomputed per-class
+                # plane, or inline when the class count exceeded the cap
+                if SC:
+                    total = rowslice(spre_s, scl)
+                else:
+                    req = [rr[r] + rowslice(used_ref, r) for r in range(R)]
+                    total = score_planes(
+                        rr,
+                        req,
+                        lambda r: rowslice(alloc_ref, r),
+                        lambda r: rowslice(maxal_ref, r),
+                        lambda r: rowslice(allocpos_ref, r),
+                        weights,
+                        valid.shape,
+                    )
+                return jnp.where(valid, total, -jnp.inf)
+
+            if SC:
+                same = (
+                    (ctrl_s[0] > 0)
+                    & (ctrl_s[1] == j)
+                    & (ctrl_s[2] == cls)
+                    & (ctrl_s[3] == scl)
+                )
+            else:
+                same = jnp.bool_(False)
+
+            @pl.when(jnp.logical_not(same))
+            def _full():
+                masked_s[:] = masked_rows(lambda ref, q: ref[q])
+
+            @pl.when(same & (ctrl_s[4] >= 0))
+            def _inc():
+                dq = ctrl_s[4] // LANES
+                masked_s[pl.ds(dq, 1), :] = masked_rows(
+                    lambda ref, q: ref[q, pl.ds(dq, 1), :]
+                )
+
+            masked = masked_s[:]
             m = jnp.max(masked)
             okm = jnp.isfinite(m)
             nstar = jnp.min(jnp.where(masked == m, idxp, INT_BIG))
 
             @pl.when(okm)
             def _():
-                colmask = idxp == nstar
+                bq = nstar // LANES
+                selr = lane1 == nstar % LANES  # [1, 128] column mask
+
+                def rowat(ref, q):
+                    return ref[q, pl.ds(bq, 1), :]
+
+                elig_row = [elig_view(k, rowat) for k in range(K)]
                 # evict in slot order until the preemptor fits — exactly
-                # the host's victims_queue drain (preempt.go:216-233)
-                cum = [jnp.zeros(shape, jnp.float32) for _ in range(R)]
+                # the host's victims_queue drain (preempt.go:216-233),
+                # all ops restricted to the chosen node's sublane row
+                cum = [jnp.zeros((1, LANES), jnp.float32) for _ in range(R)]
                 for k in range(K):
                     notfit = None
                     for r in range(R):
-                        lane_bad = ~(rr[r] < fi_s[r] + cum[r] + tol_ref[0, r])
+                        lane_bad = ~(
+                            rr[r] < rowat(fi_s, r) + cum[r] + tol_ref[0, r]
+                        )
                         if r >= 2:
                             lane_bad = lane_bad & ~(rr[r] <= tol_ref[0, r])
                         notfit = lane_bad if notfit is None else notfit | lane_bad
-                    ev_k = elig[k] & colmask & notfit  # ≤1 true element
+                    ev_k = elig_row[k] & selr & notfit  # ≤1 true element
                     for r in range(R):
-                        cum[r] = cum[r] + jnp.where(ev_k, vr_ref[r * K + k], 0.0)
-                    alive_s[k] = jnp.where(ev_k, 0.0, alive_s[k])
-                    evic_s[k] = jnp.where(ev_k, 1, evic_s[k])
+                        cum[r] = cum[r] + jnp.where(ev_k, rowat(vr_ref, r * K + k), 0.0)
+                    alive_s[k, pl.ds(bq, 1), :] = jnp.where(
+                        ev_k, 0.0, rowat(alive_s, k)
+                    )
+                    evic_s[k, pl.ds(bq, 1), :] = jnp.where(
+                        ev_k, 1, rowat(evic_s, k)
+                    )
+                    sens_k = jnp.max(jnp.where(ev_k, rowat(vsens_ref, k), 0.0))
                     ev_any = jnp.max(jnp.where(ev_k, 1, 0))
 
-                    @pl.when(ev_any > 0)
+                    # gang bookkeeping for the evicted victim's job:
+                    # ready -= 1, refresh its victims' allowances
+                    # (gang.go:75-94 at the new ready count).  This is
+                    # the one NON-LOCAL mutation — and for a
+                    # non-sensitive job (every victim has min==1) the
+                    # refresh provably rewrites identical values, and
+                    # the ready count feeds nothing else (the pack
+                    # guard refuses victim jobs that are also
+                    # preemptors), so the whole block is skipped.
+                    @pl.when((ev_any > 0) & (sens_k > 0.0))
                     def _():
-                        # gang bookkeeping for the evicted victim's job:
-                        # ready -= 1, refresh its victims' allowances
-                        # (gang.go:75-94 at the new ready count)
-                        j_e = jnp.sum(jnp.where(ev_k, vjob_ref[k], 0))
+                        j_e = jnp.sum(jnp.where(ev_k, rowat(vjob_ref, k), 0))
                         ready_s[0] = ready_s[0] - jnp.where(jidx == j_e, 1.0, 0.0)
                         rj = jread_f(ready_s[0], j_e)
                         for k2 in range(K):
@@ -342,31 +422,46 @@ def _make_preempt_kernel(
                             galw_s[k2] = jnp.where(
                                 vjob_ref[k2] == j_e, refreshed, galw_s[k2]
                             )
+                        # the cached masked plane is stale beyond this row
+                        ctrl_s[0] = jnp.int32(-2)
 
                 for r in range(R):
-                    fi_s[r] = fi_s[r] + cum[r]
+                    fi_s[r, pl.ds(bq, 1), :] = rowat(fi_s, r) + cum[r]
 
                 # final fit at nstar (guaranteed by validation, kept as
                 # the literal host check) → pipeline
                 fitp = None
                 for r in range(R):
-                    lane_ok = rr[r] < fi_s[r] + tol_ref[0, r]
+                    lane_ok = rr[r] < rowat(fi_s, r) + tol_ref[0, r]
                     if r >= 2:
                         lane_ok = lane_ok | (rr[r] <= tol_ref[0, r])
                     fitp = lane_ok if fitp is None else fitp & lane_ok
-                okfit = jnp.max(jnp.where(colmask & fitp, 1, 0)) > 0
+                okfit = jnp.max(jnp.where(selr & fitp, 1, 0)) > 0
 
                 @pl.when(okfit)
                 def _():
                     for r in range(R):
-                        fi_s[r] = fi_s[r] - jnp.where(colmask, rr[r], 0.0)
-                    ncnt_s[0] = ncnt_s[0] + jnp.where(colmask, 1.0, 0.0)
+                        fi_s[r, pl.ds(bq, 1), :] = rowat(fi_s, r) - jnp.where(
+                            selr, rr[r], 0.0
+                        )
+                    ncnt_s[0, pl.ds(bq, 1), :] = rowat(ncnt_s, 0) + jnp.where(
+                        selr, 1.0, 0.0
+                    )
                     wait_s[0] = wait_s[0] + jnp.where(jidx == j, 1.0, 0.0)
-                    pipe_s[:] = jnp.where(pidx == p, nstar, pipe_s[:])
+                    pq = p // LANES
+                    pipe_s[pl.ds(pq, 1), :] = jnp.where(
+                        lane1 == p % LANES, nstar, pipe_s[pl.ds(pq, 1), :]
+                    )
 
-            # assigned ⟺ this task's pipelined entry got written (entries
-            # start at -1 and p is visited at most once per live attempt)
-            return jnp.max(jnp.where(pidx == p, pipe_s[:], -1)) >= 0
+            # cache bookkeeping: valid unless a sensitive refresh fired
+            # (ctrl_s[0] == -2 sentinel written inside the drain); dirty
+            # column = the touched node on success, clean otherwise
+            invalidated = ctrl_s[0] == -2
+            ctrl_s[0] = jnp.where(invalidated, 0, 1)
+            ctrl_s[1] = j
+            ctrl_s[2] = cls
+            ctrl_s[3] = scl
+            ctrl_s[4] = jnp.where(okm, nstar, jnp.int32(-1))
 
         # ---- schedule slot loop ----
         def slot(s, _):
@@ -501,35 +596,25 @@ def prepare_preempt_arrays(pk: PreemptPacked) -> Tuple[dict, dict, np.ndarray]:
     per_node_max = np.bincount(vnode, minlength=1).max(initial=0) if V else 0
     K = int(max(1, per_node_max))
 
+    # Only vr + vjob ship (the other victim planes — vq/vjp/vjmin/galw0/
+    # alive0/vsens — derive on DEVICE from the tiny per-job tables via
+    # gathers: every transferred byte rides the device link, and victim
+    # planes were ~2/3 of the pass's bytes).  Empty slots carry vjob=-1.
     vr = np.zeros((R * K, NK), dtype=np.float32)
-    vjob = np.zeros((K, NK), dtype=np.int32)
-    vq = np.full((K, NK), -2, dtype=np.int32)
-    vjp = np.zeros((K, NK), dtype=np.int32)
-    vjmin = np.zeros((K, NK), dtype=np.float32)
-    galw0 = np.zeros((K, NK), dtype=np.float32)
-    alive0 = np.zeros((K, NK), dtype=np.float32)
+    vjob = np.full((K, NK), -1, dtype=np.int32)
+    job_sens = np.zeros(max(pk.n_jobs, 1), dtype=bool)
     if V:
         ks = vic_slot[:V]
         jrows = pk.vic_job[:V]
         for r in range(R):
             vr[r * K + ks, vnode] = pk.vic_resreq[:V, r]
         vjob[ks, vnode] = jrows
-        vq[ks, vnode] = pk.job_queue[jrows]
-        vjp[ks, vnode] = np.clip(
-            pk.job_prio[jrows], -(2**31), 2**31 - 1
-        ).astype(np.int32)
-        ma = pk.job_min_avail[jrows]
-        rd = pk.job_ready0[jrows]
-        vjmin[ks, vnode] = ma.astype(np.float32)
-        alive0[ks, vnode] = 1.0
-        galw0[ks, vnode] = ((ma <= rd - 1) | (ma == 1)).astype(np.float32)
+        # sensitivity: evicting a victim of job j can change an allowance
+        # iff some victim of j has min_available != 1 (allowances of
+        # min==1 victims refresh to 1 — a no-op)
+        np.logical_or.at(job_sens, jrows, pk.job_min_avail[jrows] != 1)
     vr = vr.reshape(R * K, NS, LANES)
     vjob = vjob.reshape(K, NS, LANES)
-    vq = vq.reshape(K, NS, LANES)
-    vjp = vjp.reshape(K, NS, LANES)
-    vjmin = vjmin.reshape(K, NS, LANES)
-    galw0 = galw0.reshape(K, NS, LANES)
-    alive0 = alive0.reshape(K, NS, LANES)
 
     # class feasibility planes (same construction as the allocate kernel)
     task_cls, class_sel, class_tol = _feasibility_classes(base)
@@ -616,8 +701,11 @@ def prepare_preempt_arrays(pk: PreemptPacked) -> Tuple[dict, dict, np.ndarray]:
     # each instead of ~14 (each transfer pays the device-link round trip;
     # maxal/allocpos are derived on device from alloc).  Row layout:
     #   f32: cf[C] | used[R] | alloc[R] | fi0[R] | naux[2] | vr[R*K]
-    #        | vjmin[K] | vinit[2K]
-    #   i32: vjob[K] | vq[K] | vjp[K]
+    #   (victim metadata planes — vq/vjp/vjmin/galw0/alive0/vsens — are
+    #   DERIVED on device from vjob + the per-job tables; see
+    #   _preempt_call)
+    #        | vjmin[K] | vinit[2K] | vsens[K]
+    #   i32: vjob[K] (-1 = empty slot)
     fstack = np.concatenate(
         [
             np.ascontiguousarray(cf.reshape(C, NS, LANES)),
@@ -626,20 +714,18 @@ def prepare_preempt_arrays(pk: PreemptPacked) -> Tuple[dict, dict, np.ndarray]:
             planes(pk.node_fi0),
             naux,
             vr,
-            vjmin,
-            np.concatenate([galw0, alive0]),
         ]
     )
-    istack = np.concatenate([vjob, vq, vjp])
     arrays = dict(
         tol=base.tolerance.reshape(1, R).astype(np.float32),
         ptask=ptask,
         screq=screq,
         fstack=fstack,
-        istack=istack,
+        istack=vjob,
         jobsf=jobsf,
         jobsmem=jobsmem,
         minav=minav,
+        jsens=jflat(job_sens.astype(np.float32), np.float32),
     )
     dims = dict(R=R, K=K, NS=NS, JS=JS, PS=PS, C=C, NK=NK, SC=SC)
     return arrays, dims, vic_slot
@@ -648,18 +734,42 @@ def prepare_preempt_arrays(pk: PreemptPacked) -> Tuple[dict, dict, np.ndarray]:
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "R", "K", "C", "NS", "JS", "PS", "SB", "SC", "weights", "interpret"
+        "R", "K", "C", "NS", "JS", "PS", "SB", "SC", "S4", "P_pad",
+        "SC_rows", "weights", "interpret"
     ),
 )
 def _preempt_call(
-    tol, sched, ptask, screq, fstack, istack, jobsf, jobsmem, minav,
-    R, K, C, NS, JS, PS, SB, SC, weights, interpret,
+    buf,  # uint8 [total] — EVERY kernel operand in one transfer (each
+    #       host→device array pays the full link round trip; nine
+    #       separate puts were ~200ms of the pass on the dev tunnel)
+    R, K, C, NS, JS, PS, SB, SC, S4, P_pad, SC_rows, weights, interpret,
 ):
-    S = sched.shape[0] // 4  # sched arrives flat [S_pad*4]
+    S = S4 // 4  # sched is flat [S_pad*4]
     G = S // SB
     kernel = _make_preempt_kernel(R, K, NS, JS, PS, SB, SC, weights)
+    JPAD = JS * LANES
+    FROWS = C + 3 * R + 2 + R * K
 
-    # device-side unpack of the stacked transfer buffers (XLA slices)
+    # device-side unpack: byte slices bitcast to f32/i32 (XLA ops)
+    off = [0]
+
+    def take(n_elems, dtype):
+        nbytes = n_elems * 4
+        sl = jax.lax.dynamic_slice_in_dim(buf, off[0], nbytes)
+        off[0] += nbytes
+        return jax.lax.bitcast_convert_type(sl.reshape(-1, 4), dtype)
+
+    tol = take(R, jnp.float32).reshape(1, R)
+    ptask = take(P_pad * (R + 2), jnp.float32).reshape(P_pad, R + 2)
+    screq = take(SC_rows * R, jnp.float32).reshape(SC_rows, R)
+    fstack = take(FROWS * NS * LANES, jnp.float32).reshape(FROWS, NS, LANES)
+    jobsf = take(2 * JS * LANES, jnp.float32).reshape(2, JS, LANES)
+    minav = take(JPAD, jnp.float32)
+    jsens = take(JPAD, jnp.float32)
+    sched = take(S4, jnp.int32)
+    vjob = take(K * NS * LANES, jnp.int32).reshape(K, NS, LANES)
+    jobsmem = take(3 * JPAD, jnp.int32)
+
     o = 0
     cf = fstack[o : o + C]; o += C
     used = fstack[o : o + R]; o += R
@@ -667,13 +777,29 @@ def _preempt_call(
     fi0 = fstack[o : o + R]; o += R
     naux = fstack[o : o + 2]; o += 2
     vr = fstack[o : o + R * K]; o += R * K
-    vjmin = fstack[o : o + K]; o += K
-    vinit = fstack[o : o + 2 * K]; o += 2 * K
     maxal = jnp.maximum(alloc, 1.0)
     allocpos = (alloc > 0.0).astype(jnp.float32)
-    vjob = istack[0:K]
-    vq = istack[K : 2 * K]
-    vjp = istack[2 * K : 3 * K]
+
+    # derived victim planes (gathers from the per-job tables — shipping
+    # them cost ~2/3 of the pass's transfer bytes); empty slots have
+    # vjob == -1 and derive to the same inert values the host packed
+    jq_vec = jobsmem[JPAD : 2 * JPAD]
+    jp_vec = jobsmem[2 * JPAD : 3 * JPAD]
+    ready_vec = jobsf[0].reshape(-1)
+    occupied = vjob >= 0
+    safe_j = jnp.maximum(vjob, 0)
+    vq = jnp.where(occupied, jq_vec[safe_j], -2)
+    vjp = jnp.where(occupied, jp_vec[safe_j], 0)
+    vjmin = jnp.where(occupied, minav[safe_j], 0.0)
+    alive0 = occupied.astype(jnp.float32)
+    galw0 = jnp.where(
+        occupied
+        & ((vjmin <= ready_vec[safe_j] - 1.0) | (vjmin == 1.0)),
+        1.0,
+        0.0,
+    )
+    vinit = jnp.concatenate([galw0, alive0], axis=0)
+    vsens = jnp.where(occupied, jsens[safe_j], 0.0)
 
     full = lambda *shape: pl.BlockSpec(
         shape, lambda i: tuple(0 for _ in shape), memory_space=pltpu.VMEM
@@ -699,6 +825,7 @@ def _preempt_call(
             full(K, NS, LANES),
             full(K, NS, LANES),
             full(2 * K, NS, LANES),
+            full(K, NS, LANES),
             full(2, JS, LANES),
             pl.BlockSpec(
                 (3 * JS * LANES,), lambda i: (0,), memory_space=pltpu.SMEM
@@ -726,6 +853,8 @@ def _preempt_call(
             pltpu.SMEM((JS * LANES,), jnp.int32),
             pltpu.VMEM((PS, LANES), jnp.int32),
             pltpu.VMEM((screq.shape[0], NS, LANES), jnp.float32),
+            pltpu.VMEM((NS, LANES), jnp.float32),
+            pltpu.SMEM((5,), jnp.int32),
             pltpu.VMEM((R, NS, LANES), jnp.float32),
             pltpu.VMEM((1, NS, LANES), jnp.float32),
             pltpu.VMEM((K, NS, LANES), jnp.float32),
@@ -738,9 +867,12 @@ def _preempt_call(
         interpret=interpret,
     )(
         tol, sched, ptask, screq, cf, used, alloc, maxal, allocpos, fi0, naux,
-        vr, vjob, vq, vjp, vjmin, vinit, jobsf, jobsmem, minav,
+        vr, vjob, vq, vjp, vjmin, vinit, vsens, jobsf, jobsmem, minav,
     )
-    return evicted, pipelined
+    # ONE fused output fetch: [K*NS + PS, 128] i32
+    return jnp.concatenate(
+        [evicted.reshape(K * NS, LANES), pipelined], axis=0
+    )
 
 
 def preempt_vmem_bytes(pk: PreemptPacked) -> int:
@@ -770,9 +902,10 @@ def preempt_vmem_bytes(pk: PreemptPacked) -> int:
     plane = NK * 4
     n_planes = (
         C + 5 * R + 2  # cf + used/alloc/maxal/allocpos/fi0 + naux
-        + R * K + 6 * K  # victim planes (vr, vjob/vq/vjp/vjmin, vinit×2)
+        + R * K + 7 * K  # victim planes (vr, vjob/vq/vjp/vjmin, vinit×2, vsens)
         + (R + 1 + 3 * K) * 2  # node scratch + shadows
         + SC_pad  # precomputed per-class score plane scratch (padded)
+        + 1  # cached masked plane
     )
     # jobsf (2 rows) + ready/wait scratch and shadows (4 rows of [1,JS,128])
     job_planes = (2 + 4) * JS * LANES * 4
@@ -820,22 +953,31 @@ def run_preempt_pallas(
     sched[:S] = slots
     sched = np.ascontiguousarray(sched.reshape(-1))  # flat for SMEM
 
-    ev_planes, pipe_planes = _preempt_call(
-        jnp.asarray(arrays["tol"]),
-        jnp.asarray(sched),
-        jnp.asarray(arrays["ptask"]),
-        jnp.asarray(arrays["screq"]),
-        jnp.asarray(arrays["fstack"]),
-        jnp.asarray(arrays["istack"]),
-        jnp.asarray(arrays["jobsf"]),
-        jnp.asarray(arrays["jobsmem"]),
-        jnp.asarray(arrays["minav"]),
+    # single transfer buffer: f32 parts then i32 parts, as raw bytes
+    buf = np.concatenate([
+        np.ascontiguousarray(arrays["tol"]).view(np.uint8).ravel(),
+        np.ascontiguousarray(arrays["ptask"]).view(np.uint8).ravel(),
+        np.ascontiguousarray(arrays["screq"]).view(np.uint8).ravel(),
+        np.ascontiguousarray(arrays["fstack"]).view(np.uint8).ravel(),
+        np.ascontiguousarray(arrays["jobsf"]).view(np.uint8).ravel(),
+        np.ascontiguousarray(arrays["minav"]).view(np.uint8).ravel(),
+        np.ascontiguousarray(arrays["jsens"]).view(np.uint8).ravel(),
+        sched.view(np.uint8).ravel(),
+        np.ascontiguousarray(arrays["istack"]).view(np.uint8).ravel(),
+        np.ascontiguousarray(arrays["jobsmem"]).view(np.uint8).ravel(),
+    ])
+
+    out = np.asarray(_preempt_call(
+        jnp.asarray(buf),
         R=dims["R"], K=dims["K"], C=dims["C"], NS=dims["NS"], JS=dims["JS"],
-        PS=dims["PS"], SB=SB, SC=dims["SC"], weights=weights,
-        interpret=interpret,
-    )
-    ev_planes = np.asarray(ev_planes)
-    pipe_flat = np.asarray(pipe_planes).reshape(-1)
+        PS=dims["PS"], SB=SB, SC=dims["SC"], S4=int(sched.shape[0]),
+        P_pad=int(arrays["ptask"].shape[0]),
+        SC_rows=int(arrays["screq"].shape[0]),
+        weights=weights, interpret=interpret,
+    ))
+    K, NS = dims["K"], dims["NS"]
+    ev_planes = out[: K * NS].reshape(K, NS, LANES)
+    pipe_flat = out[K * NS :].reshape(-1)
 
     if V:
         sub = pk.vic_node[:V] // LANES
