@@ -81,13 +81,19 @@ def bench_config(name: str, kwargs: dict, iters: int = 5) -> dict:
     # sessions use the host C++ path), so vs_baseline is parity by design.
     executor = select_executor(snap)
 
-    # Session input volume ≈ what run_packed_pallas actually ships per
-    # session (task rows + node planes + class feasibility).
-    in_bytes = int(
-        snap.task_resreq.nbytes
-        + snap.task_resreq.shape[0] * 8
-        + snap.node_idle.nbytes * 4
-    )
+    # Session input volume = what the executor actually ships per
+    # steady-state session (pallas: the deduplicated session buffer —
+    # cluster planes are device-resident across sessions).
+    if executor == "pallas":
+        from volcano_tpu.ops.pallas_session import pallas_session_payload_bytes
+
+        in_bytes = pallas_session_payload_bytes(snap)
+    else:
+        in_bytes = int(
+            snap.task_resreq.nbytes
+            + snap.task_resreq.shape[0] * 8
+            + snap.node_idle.nbytes * 4
+        )
     relay_s = _relay_floor_s(in_bytes=in_bytes, out_elems=snap.n_tasks)
 
     # Device path: end-to-end host→device→assignment latency.  The

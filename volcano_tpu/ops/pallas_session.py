@@ -540,6 +540,157 @@ def pallas_vmem_bytes(snap: PackedSnapshot, block_size: int = 256) -> int:
     return n_planes * NK * 4 + 2 * block_size * LANES * 4
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "T_rows", "R", "U", "C", "ND", "NS", "JP",
+        "weights", "block_size", "gang_rounds", "interpret",
+    ),
+)
+def schedule_session_pallas_buf(
+    session_buf: jnp.ndarray,  # uint8 — header(i32) | tol | templates |
+    #                            row_id(u16) | job(u16) | jobs2(i32)
+    cluster_buf: jnp.ndarray,  # uint8 — cf_u8 | nd(f32)
+    T_rows: int, R: int, U: int, C: int, ND: int, NS: int, JP: int,
+    weights: ScoreWeights = DEFAULT_WEIGHTS,
+    block_size: int = 256,
+    gang_rounds: int = 3,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Two-buffer entry: the per-SESSION payload and the per-CLUSTER
+    payload (class feasibility + node planes) arrive as two byte
+    buffers, bitcast-unpacked on device.  The cluster buffer is
+    content-addressed and cached device-side by run_packed_pallas, so
+    steady-state sessions ship ONE transfer — and that transfer carries
+    DEDUPLICATED task-row templates plus u16 per-task indices instead of
+    full f32 rows (gang replicas stamped from one PodTemplate share a
+    row, so the 50k-task headline payload compresses ~6x; the device
+    link's bandwidth was ~96% of session e2e)."""
+    o = 0
+    hdr = jax.lax.bitcast_convert_type(
+        jax.lax.dynamic_slice_in_dim(session_buf, o, 4).reshape(1, 4), jnp.int32
+    )
+    n_act = hdr[0]
+    o += 4
+    tol_b = jax.lax.dynamic_slice_in_dim(session_buf, o, R * 4); o += R * 4
+    tpl_b = jax.lax.dynamic_slice_in_dim(session_buf, o, U * (R + 1) * 4)
+    o += U * (R + 1) * 4
+    rid_b = jax.lax.dynamic_slice_in_dim(session_buf, o, T_rows * 2)
+    o += T_rows * 2
+    tj_b = jax.lax.dynamic_slice_in_dim(session_buf, o, T_rows * 2)
+    o += T_rows * 2
+    j_b = jax.lax.dynamic_slice_in_dim(session_buf, o, 2 * JP * 4)
+
+    tol = jax.lax.bitcast_convert_type(tol_b.reshape(-1, 4), jnp.float32).reshape(1, R)
+    templates = jax.lax.bitcast_convert_type(
+        tpl_b.reshape(-1, 4), jnp.float32
+    ).reshape(U, R + 1)
+    row_id = jax.lax.bitcast_convert_type(
+        rid_b.reshape(-1, 2), jnp.uint16
+    ).astype(jnp.int32)
+    task_job = jax.lax.bitcast_convert_type(
+        tj_b.reshape(-1, 2), jnp.uint16
+    ).astype(jnp.int32)
+    jobs2 = jax.lax.bitcast_convert_type(
+        j_b.reshape(-1, 4), jnp.int32
+    ).reshape(2, JP)
+
+    # reconstruct the full task rows device-side: template gather +
+    # active column (first n_act tasks) + job column
+    rows = templates[row_id]  # [T_rows, R+1]
+    active = (jnp.arange(T_rows) < n_act).astype(jnp.float32)
+    taskrow_ext = jnp.concatenate(
+        [rows, active[:, None], task_job.astype(jnp.float32)[:, None]], axis=1
+    )
+
+    cf_u8 = jax.lax.dynamic_slice_in_dim(cluster_buf, 0, C * NS * LANES).reshape(
+        C, NS, LANES
+    )
+    nd_b = jax.lax.dynamic_slice_in_dim(
+        cluster_buf, C * NS * LANES, ND * NS * LANES * 4
+    )
+    nd = jax.lax.bitcast_convert_type(
+        nd_b.reshape(-1, 4), jnp.float32
+    ).reshape(ND, NS, LANES)
+
+    return schedule_session_pallas_packed(
+        taskrow_ext, cf_u8, nd, tol, jobs2,
+        weights=weights, block_size=block_size, gang_rounds=gang_rounds,
+        interpret=interpret,
+    )
+
+
+#: device-resident cluster planes, keyed by content fingerprint — nodes
+#: change slowly relative to the 1s session cadence, so steady-state
+#: sessions skip re-shipping them entirely (SURVEY §7 hard-part 5: the
+#: per-cycle deep copy the reference pays, retired on the device side)
+_CLUSTER_CACHE: "dict" = {}
+_CLUSTER_CACHE_MAX = 4
+
+
+def _cached_cluster_buf(cf_u8: np.ndarray, nd: np.ndarray):
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    h.update(cf_u8.tobytes())
+    h.update(nd.tobytes())
+    key = (cf_u8.shape, nd.shape, h.digest())
+    hit = _CLUSTER_CACHE.get(key)
+    if hit is not None:
+        return hit
+    buf = np.concatenate([
+        np.ascontiguousarray(cf_u8).ravel().view(np.uint8),
+        np.ascontiguousarray(nd).view(np.uint8).ravel(),
+    ])
+    dev = jax.device_put(jnp.asarray(buf))
+    if len(_CLUSTER_CACHE) >= _CLUSTER_CACHE_MAX:
+        _CLUSTER_CACHE.pop(next(iter(_CLUSTER_CACHE)))
+    _CLUSTER_CACHE[key] = dev
+    return dev
+
+
+def _template_rows(snap: PackedSnapshot, rows: np.ndarray):
+    """(first_idx, inverse) over distinct task rows, memoized on the
+    snapshot.  Column-cascaded 1D uniques (the _feasibility_classes
+    trick — ~5x cheaper than a void-key sort at 50k rows); float columns
+    compare by BIT pattern, which equals value equality here (resreq
+    lanes and class ids are non-negative finite, no -0.0)."""
+    cached = getattr(snap, "_tpl_cache", None)
+    if cached is not None and cached[0] == rows.shape:
+        return cached[1]
+    bits = rows.view(np.uint32)
+    T, Wc = bits.shape
+    code = np.zeros(T, dtype=np.int64)
+    for c in range(Wc):
+        u, inv = np.unique(bits[:, c], return_inverse=True)
+        code = code * np.int64(len(u)) + inv
+        if c < Wc - 1:
+            _, code = np.unique(code, return_inverse=True)
+            code = code.astype(np.int64)
+    uc, inverse = np.unique(code, return_inverse=True)
+    first = np.full(len(uc), T, dtype=np.int64)
+    np.minimum.at(first, inverse, np.arange(T, dtype=np.int64))
+    # keyed by the padded row shape — block_size changes the padding
+    result = (first, inverse.astype(np.int64))
+    snap._tpl_cache = (rows.shape, result)
+    return result
+
+
+def pallas_session_payload_bytes(snap: PackedSnapshot, block_size: int = 256) -> int:
+    """Steady-state per-session transfer volume for run_packed_pallas
+    (the deduplicated session buffer; cluster planes ride the
+    device-resident cache).  Used by bench.py's relay-floor estimate so
+    the floor models what the session actually ships."""
+    arrays, T_act, _ = prepare_pallas_arrays(snap, block_size)
+    T_rows = arrays["taskrow"].shape[0]
+    R = arrays["taskrow"].shape[1] - 2
+    rows = np.ascontiguousarray(arrays["taskrow"][:, : R + 1])
+    first_idx, _ = _template_rows(snap, rows)
+    U = int(first_idx.shape[0])
+    JP = snap.job_min_available.shape[0]
+    return 4 + R * 4 + U * (R + 1) * 4 + T_rows * 4 + 2 * JP * 4
+
+
 def run_packed_pallas(
     snap: PackedSnapshot,
     weights: ScoreWeights = DEFAULT_WEIGHTS,
@@ -549,7 +700,8 @@ def run_packed_pallas(
 ) -> np.ndarray:
     """Host wrapper: PackedSnapshot → assignment[T].  Packs, makes ONE
     fused device call (gang fixpoint included — schedule_session_pallas),
-    fetches the committed assignment."""
+    fetches the committed assignment.  The session ships as one byte
+    buffer; cluster planes ride the device-resident cache."""
     if not f32_lr_exact(snap):
         # Outside the f32 floor-division exactness envelope — the caller
         # (run_packed_auto) routes such sessions to the XLA int path.
@@ -557,33 +709,69 @@ def run_packed_pallas(
 
     arrays, T_act, _ = prepare_pallas_arrays(snap, block_size)
 
-    # active0 + task_job ride inside the task rows (f32 int-exact: job
-    # rows stay far below 2^24) — see schedule_session_pallas_packed.
     T_rows = arrays["taskrow"].shape[0]
-    taskrow_ext = np.zeros((T_rows, arrays["taskrow"].shape[1] + 1), np.float32)
-    taskrow_ext[:, :-1] = arrays["taskrow"]
+    R = arrays["taskrow"].shape[1] - 2
     n_act = min(snap.n_tasks, T_act)
-    taskrow_ext[:n_act, -2] = 1.0  # active column
-    n_tj = min(T_act, snap.task_job.shape[0])
-    taskrow_ext[:n_tj, -1] = snap.task_job[:n_tj].astype(np.float32)
     jobs2 = np.stack(
         [
             snap.job_min_available.astype(np.int32),
             snap.job_ready_count.astype(np.int32),
         ]
     )
+    JP = jobs2.shape[1]
 
-    out = schedule_session_pallas_packed(
-        jnp.asarray(taskrow_ext),
-        jnp.asarray(arrays["cf_u8"]),
-        jnp.asarray(arrays["nd"]),
-        jnp.asarray(arrays["tol"]),
-        jnp.asarray(jobs2),
-        weights=weights,
-        block_size=block_size,
-        gang_rounds=gang_rounds,
-        interpret=interpret,
-    )
+    # deduplicate (resreq lanes, class) rows into templates + u16 ids
+    rows = np.ascontiguousarray(arrays["taskrow"][:, : R + 1])
+    first_idx, inv = _template_rows(snap, rows)
+    U = int(first_idx.shape[0])
+
+    task_job16 = np.zeros(T_rows, dtype=np.uint16)
+    n_tj = min(T_act, snap.task_job.shape[0])
+    if U >= 2**16 or JP >= 2**16 or int(snap.task_job[:n_tj].max(initial=0)) >= 2**16:
+        # degenerate row diversity — ship full rows the old 5-transfer way
+        taskrow_ext = np.zeros((T_rows, R + 3), np.float32)
+        taskrow_ext[:, : R + 1] = rows
+        taskrow_ext[:n_act, R + 1] = 1.0
+        taskrow_ext[:n_tj, R + 2] = snap.task_job[:n_tj].astype(np.float32)
+        out = schedule_session_pallas_packed(
+            jnp.asarray(taskrow_ext),
+            jnp.asarray(arrays["cf_u8"]),
+            jnp.asarray(arrays["nd"]),
+            jnp.asarray(arrays["tol"]),
+            jnp.asarray(jobs2),
+            weights=weights, block_size=block_size,
+            gang_rounds=gang_rounds, interpret=interpret,
+        )
+    else:
+        task_job16[:n_tj] = snap.task_job[:n_tj].astype(np.uint16)
+        # pad U to a power-of-two bucket: U is a static jit arg AND sizes
+        # the buffer, so an unpadded count would retrace the fused kernel
+        # whenever the distinct-row count drifts between sessions (zero
+        # template rows are inert — no row_id points at them)
+        U_pad = 8
+        while U_pad < U:
+            U_pad *= 2
+        templates = np.zeros((U_pad, R + 1), dtype=np.float32)
+        templates[:U] = rows[first_idx]
+        session_buf = np.concatenate([
+            np.array([n_act], dtype=np.int32).view(np.uint8),
+            np.ascontiguousarray(arrays["tol"]).view(np.uint8).ravel(),
+            templates.view(np.uint8).ravel(),
+            inv.astype(np.uint16).view(np.uint8),
+            task_job16.view(np.uint8),
+            np.ascontiguousarray(jobs2).view(np.uint8).ravel(),
+        ])
+        cluster = _cached_cluster_buf(arrays["cf_u8"], arrays["nd"])
+        out = schedule_session_pallas_buf(
+            jnp.asarray(session_buf),
+            cluster,
+            T_rows=T_rows, R=R, U=U_pad, C=arrays["cf_u8"].shape[0],
+            ND=arrays["nd"].shape[0], NS=arrays["nd"].shape[1], JP=JP,
+            weights=weights,
+            block_size=block_size,
+            gang_rounds=gang_rounds,
+            interpret=interpret,
+        )
     out = np.asarray(out)
     assignment = np.full(snap.n_tasks, -1, dtype=np.int32)
     n = min(snap.n_tasks, T_act)

@@ -704,7 +704,6 @@ def prepare_preempt_arrays(pk: PreemptPacked) -> Tuple[dict, dict, np.ndarray]:
     #   (victim metadata planes — vq/vjp/vjmin/galw0/alive0/vsens — are
     #   DERIVED on device from vjob + the per-job tables; see
     #   _preempt_call)
-    #        | vjmin[K] | vinit[2K] | vsens[K]
     #   i32: vjob[K] (-1 = empty slot)
     fstack = np.concatenate(
         [
