@@ -75,3 +75,33 @@ def test_sharded_matches_single_chip_gang_discard(mesh):
     sharded = run_packed_sharded(snap, mesh)
     assert (single == sharded).all()
     assert (single == -1).any()  # scenario actually exercises discards
+
+
+def test_dispatch_selects_sharded_on_mesh():
+    """VERDICT r4 item 5: the production dispatcher must route big
+    multi-device sessions to the sharded formulation (node width over
+    the threshold, pallas unavailable off-TPU) and produce the same
+    bindings as the reference scan."""
+    from volcano_tpu.ops.dispatch import (
+        _SHARD_MIN_NODES,
+        run_packed_auto,
+        select_executor,
+    )
+    from volcano_tpu.ops.synthetic import generate_snapshot
+
+    assert len(jax.devices()) >= 2  # conftest forces the 8-device mesh
+    snap = generate_snapshot(
+        n_tasks=1_024, n_nodes=max(2_048, _SHARD_MIN_NODES), gang_size=4,
+        seed=3, label_classes=4,
+    )
+    assert select_executor(snap) == "sharded"
+    assert (run_packed_auto(snap) == run_packed(snap)).all()
+
+
+def test_dispatch_small_session_stays_single_chip():
+    from volcano_tpu.ops.dispatch import run_packed_auto, select_executor
+    from volcano_tpu.ops.synthetic import generate_snapshot
+
+    snap = generate_snapshot(n_tasks=128, n_nodes=64, gang_size=4, seed=1)
+    assert select_executor(snap) in ("native", "xla-scan")
+    assert (run_packed_auto(snap) == run_packed(snap)).all()

@@ -38,6 +38,10 @@ _VMEM_BUDGET = 96 * 1024 * 1024
 #: per-job scalar state must fit (large-J sessions fall back to dense).
 _SMEM_BUDGET = 768 * 1024
 
+#: node count above which a multi-device session shards the node axis
+#: instead of running the single-chip blocked formulation
+_SHARD_MIN_NODES = 2_048
+
 
 def _tpu_available() -> bool:
     try:
@@ -48,11 +52,26 @@ def _tpu_available() -> bool:
         return False
 
 
+def _device_count() -> int:
+    try:
+        import jax
+
+        return len(jax.devices())
+    except Exception:  # pragma: no cover - jax init failure
+        return 1
+
+
 def select_executor(
     snap: PackedSnapshot, weights: ScoreWeights = DEFAULT_WEIGHTS
 ) -> str:
     """Which executor run_packed_auto will use: 'native' | 'pallas' |
-    'blocked' | 'xla-scan'."""
+    'sharded' | 'blocked' | 'xla-scan'.
+
+    Multi-chip policy (BASELINE config 5 'pmap over v5e-8'; the scale
+    coping the reference does with 16-way goroutines + subsampling,
+    scheduler_helper.go:42-117): sessions too big for one chip's VMEM —
+    or beyond the single-chip node-width threshold — shard the node axis
+    over the mesh when ≥2 devices exist; single-chip otherwise."""
     area = max(snap.n_tasks, 1) * max(snap.n_nodes, 1)
     if area < _SMALL_AREA:
         if weights == DEFAULT_WEIGHTS:
@@ -66,6 +85,8 @@ def select_executor(
 
         if pallas_vmem_bytes(snap) <= _VMEM_BUDGET:
             return "pallas"
+    if _device_count() >= 2 and snap.n_nodes >= _SHARD_MIN_NODES:
+        return "sharded"
     return "blocked"
 
 
@@ -152,6 +173,30 @@ def run_packed_auto(
 
             get_logger(__name__).error(
                 "pallas allocate failed (%s); blocked fallback", e
+            )
+            return run_packed_blocked(
+                snap, weights=weights, gang_rounds=gang_rounds
+            )
+    if executor == "sharded":
+        import jax
+        from jax.sharding import Mesh
+
+        from volcano_tpu.ops.blocked import run_packed_blocked
+        from volcano_tpu.ops.sharded import run_packed_sharded
+
+        devices = jax.devices()
+        # the node axis shards evenly with dummy padding inside
+        # run_packed_sharded; the mesh is 1-D over all devices
+        mesh = Mesh(np.array(devices), ("nodes",))
+        try:
+            return run_packed_sharded(
+                snap, mesh, weights=weights, gang_rounds=gang_rounds
+            )
+        except Exception as e:  # noqa: BLE001 — degrade like the other paths
+            from volcano_tpu.utils.logging import get_logger
+
+            get_logger(__name__).error(
+                "sharded allocate failed (%s); blocked fallback", e
             )
             return run_packed_blocked(
                 snap, weights=weights, gang_rounds=gang_rounds

@@ -649,15 +649,15 @@ def _cached_cluster_buf(cf_u8: np.ndarray, nd: np.ndarray):
     return dev
 
 
-def _template_rows(snap: PackedSnapshot, rows: np.ndarray):
-    """(first_idx, inverse) over distinct task rows, memoized on the
-    snapshot.  Column-cascaded 1D uniques (the _feasibility_classes
-    trick — ~5x cheaper than a void-key sort at 50k rows); float columns
-    compare by BIT pattern, which equals value equality here (resreq
-    lanes and class ids are non-negative finite, no -0.0)."""
-    cached = getattr(snap, "_tpl_cache", None)
-    if cached is not None and cached[0] == rows.shape:
-        return cached[1]
+def _template_rows(rows: np.ndarray):
+    """(first_idx, inverse) over distinct task rows.  Column-cascaded 1D
+    uniques (the _feasibility_classes trick — ~5x cheaper than a
+    void-key sort at 50k rows); float columns compare by BIT pattern,
+    which equals value equality here (resreq lanes and class ids are
+    non-negative finite, no -0.0).  Deliberately NOT memoized: the dedup
+    is a real per-session host cost every cycle pays, and hiding it
+    behind a cache would both misreport benchmarks and serve stale rows
+    for in-place-mutated snapshots."""
     bits = rows.view(np.uint32)
     T, Wc = bits.shape
     code = np.zeros(T, dtype=np.int64)
@@ -670,25 +670,39 @@ def _template_rows(snap: PackedSnapshot, rows: np.ndarray):
     uc, inverse = np.unique(code, return_inverse=True)
     first = np.full(len(uc), T, dtype=np.int64)
     np.minimum.at(first, inverse, np.arange(T, dtype=np.int64))
-    # keyed by the padded row shape — block_size changes the padding
-    result = (first, inverse.astype(np.int64))
-    snap._tpl_cache = (rows.shape, result)
-    return result
+    return first, inverse.astype(np.int64)
+
+
+def _u_pad(U: int) -> int:
+    p = 8
+    while p < U:
+        p *= 2
+    return p
 
 
 def pallas_session_payload_bytes(snap: PackedSnapshot, block_size: int = 256) -> int:
     """Steady-state per-session transfer volume for run_packed_pallas
-    (the deduplicated session buffer; cluster planes ride the
-    device-resident cache).  Used by bench.py's relay-floor estimate so
-    the floor models what the session actually ships."""
-    arrays, T_act, _ = prepare_pallas_arrays(snap, block_size)
-    T_rows = arrays["taskrow"].shape[0]
-    R = arrays["taskrow"].shape[1] - 2
-    rows = np.ascontiguousarray(arrays["taskrow"][:, : R + 1])
-    first_idx, _ = _template_rows(snap, rows)
+    (the deduplicated session buffer incl. template padding; cluster
+    planes ride the device-resident cache).  Used by bench.py's
+    relay-floor estimate so the floor models what the session actually
+    ships.  Builds only the task rows (not the node planes)."""
+    TB = block_size
+    T_pad = snap.task_resreq.shape[0]
+    T_rows = max(TB, -(-max(snap.n_tasks, 1) // TB) * TB)
+    R = snap.task_resreq.shape[1]
+    task_cls, _, _ = _feasibility_classes(snap)
+    rows = np.zeros((T_rows, R + 1), dtype=np.float32)
+    n_copy = min(T_rows, T_pad)
+    rows[:n_copy, :R] = snap.task_resreq[:n_copy]
+    rows[:n_copy, R] = task_cls[:n_copy].astype(np.float32)
+    first_idx, _ = _template_rows(rows)
     U = int(first_idx.shape[0])
     JP = snap.job_min_available.shape[0]
-    return 4 + R * 4 + U * (R + 1) * 4 + T_rows * 4 + 2 * JP * 4
+    n_tj = min(T_rows, snap.task_job.shape[0])
+    if U >= 2**16 or JP >= 2**16 or int(snap.task_job[:n_tj].max(initial=0)) >= 2**16:
+        # degenerate diversity: full f32 rows ship (5-transfer path)
+        return T_rows * (R + 3) * 4 + R * 4 + 2 * JP * 4
+    return 4 + R * 4 + _u_pad(U) * (R + 1) * 4 + T_rows * 4 + 2 * JP * 4
 
 
 def run_packed_pallas(
@@ -722,7 +736,7 @@ def run_packed_pallas(
 
     # deduplicate (resreq lanes, class) rows into templates + u16 ids
     rows = np.ascontiguousarray(arrays["taskrow"][:, : R + 1])
-    first_idx, inv = _template_rows(snap, rows)
+    first_idx, inv = _template_rows(rows)
     U = int(first_idx.shape[0])
 
     task_job16 = np.zeros(T_rows, dtype=np.uint16)
@@ -748,9 +762,7 @@ def run_packed_pallas(
         # the buffer, so an unpadded count would retrace the fused kernel
         # whenever the distinct-row count drifts between sessions (zero
         # template rows are inert — no row_id points at them)
-        U_pad = 8
-        while U_pad < U:
-            U_pad *= 2
+        U_pad = _u_pad(U)
         templates = np.zeros((U_pad, R + 1), dtype=np.float32)
         templates[:U] = rows[first_idx]
         session_buf = np.concatenate([
