@@ -1,0 +1,138 @@
+"""Compute-plane boundary: wire round-trips, sidecar-served sessions
+identical to in-process, and fallback-to-in-process when the sidecar
+dies (the north-star process separation, SURVEY §7)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from volcano_tpu.ops import executor as executor_mod
+from volcano_tpu.ops.dispatch import run_packed_auto
+from volcano_tpu.ops.synthetic import generate_preempt_packed, generate_snapshot
+from volcano_tpu.serving.compute_plane import (
+    ComputePlaneClient,
+    ComputePlaneServer,
+    deserialize_preempt,
+    deserialize_snapshot,
+    serialize_preempt,
+    serialize_snapshot,
+)
+
+
+@pytest.fixture
+def sock_path(tmp_path):
+    return str(tmp_path / "cp.sock")
+
+
+@pytest.fixture
+def sidecar(sock_path):
+    server = ComputePlaneServer(sock_path).start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture(autouse=True)
+def _reset_executor():
+    yield
+    executor_mod.configure(None)
+
+
+def test_snapshot_serialization_roundtrip():
+    snap = generate_snapshot(n_tasks=200, n_nodes=50, gang_size=4, seed=1,
+                             label_classes=3, taint_fraction=0.2)
+    back = deserialize_snapshot(serialize_snapshot(snap))
+    assert back.n_tasks == snap.n_tasks and back.n_jobs == snap.n_jobs
+    assert back.resource_names == snap.resource_names
+    np.testing.assert_array_equal(back.task_resreq, snap.task_resreq)
+    np.testing.assert_array_equal(back.node_taint_bits, snap.node_taint_bits)
+    assert (run_packed_auto(back) == run_packed_auto(snap)).all()
+
+
+def test_preempt_serialization_roundtrip():
+    from volcano_tpu.ops.preempt_pack import preempt_dense
+
+    pk = generate_preempt_packed(n_victims=400, n_nodes=40, n_preemptors=60)
+    back = deserialize_preempt(serialize_preempt(pk))
+    ev_a, pipe_a = preempt_dense(pk)
+    ev_b, pipe_b = preempt_dense(back)
+    np.testing.assert_array_equal(ev_a, ev_b)
+    np.testing.assert_array_equal(pipe_a, pipe_b)
+
+
+def test_sidecar_allocate_identical(sidecar, sock_path):
+    client = ComputePlaneClient(sock_path)
+    assert client.health()
+    snap = generate_snapshot(n_tasks=300, n_nodes=60, gang_size=4, seed=2)
+    remote = client.allocate(snap)
+    local = run_packed_auto(snap)
+    np.testing.assert_array_equal(remote, local)
+    client.close()
+
+
+def test_sidecar_preempt_identical(sidecar, sock_path):
+    from volcano_tpu.ops.preempt_pack import preempt_dense
+
+    client = ComputePlaneClient(sock_path)
+    pk = generate_preempt_packed(n_victims=300, n_nodes=30, n_preemptors=50)
+    ev_r, pipe_r = client.preempt(pk)
+    ev_l, pipe_l = preempt_dense(pk)
+    np.testing.assert_array_equal(ev_r, ev_l)
+    np.testing.assert_array_equal(pipe_r, pipe_l)
+    client.close()
+
+
+def test_executor_uses_sidecar_then_falls_back(sidecar, sock_path):
+    """The e2e fallback contract: sessions flow through the sidecar while
+    it lives; killing it degrades to in-process with identical results
+    and NO error escaping the action."""
+    executor_mod.configure(sock_path)
+    snap = generate_snapshot(n_tasks=256, n_nodes=40, gang_size=4, seed=3)
+    via_sidecar = executor_mod.execute_allocate(snap)
+    local = run_packed_auto(snap)
+    np.testing.assert_array_equal(via_sidecar, local)
+
+    sidecar.stop()  # sidecar dies mid-life
+    after_death = executor_mod.execute_allocate(snap)
+    np.testing.assert_array_equal(after_death, local)
+
+
+def test_action_through_sidecar_binds_identically(sidecar, sock_path, tmp_path):
+    """Full framework path over the boundary: the jax-allocate action
+    with the kernel executed in the SIDECAR process boundary produces
+    bindings identical to the in-process run."""
+    import copy
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+    from tests.builders import build_node, build_pod, build_pod_group, build_queue
+    from tests.scheduler_helpers import make_cache, run_actions, tiers
+    from volcano_tpu.actions.jax_allocate import JaxAllocateAction
+
+    def cluster():
+        nodes = [build_node(f"n{i}", {"cpu": "8", "memory": "32Gi"}) for i in range(4)]
+        pods, pgs = [], []
+        for j in range(5):
+            pgs.append(build_pod_group("ns", f"pg{j}", 3, queue="q"))
+            for i in range(3):
+                pods.append(
+                    build_pod("ns", f"j{j}-t{i}", "", {"cpu": "1", "memory": "2Gi"}, group=f"pg{j}")
+                )
+        return dict(nodes=nodes, pods=pods, pod_groups=pgs, queues=[build_queue("q")])
+
+    c = cluster()
+    tier_conf = tiers(["priority", "gang"],
+                      ["drf", "predicates", "proportion", "nodeorder", "binpack"])
+
+    executor_mod.configure(sock_path)
+    cache_remote = make_cache(**copy.deepcopy(c))
+    run_actions(cache_remote, [JaxAllocateAction()], tier_conf)
+
+    executor_mod.configure(None)
+    cache_local = make_cache(**copy.deepcopy(c))
+    run_actions(cache_local, [JaxAllocateAction()], tier_conf)
+
+    assert dict(cache_remote.binder.binds) == dict(cache_local.binder.binds)
+    assert len(cache_remote.binder.binds) == 15

@@ -119,7 +119,7 @@ class JaxAllocateAction(Action):
         to the host chooser.  Relational predicates the packer could not
         encode (needs_host_validation) are safe regardless: phase 3
         validates every proposal against the full host predicate set."""
-        from volcano_tpu.ops.dispatch import run_packed_auto
+        from volcano_tpu.ops.executor import execute_allocate
         from volcano_tpu.ops.packing import pack_session
 
         jobs = {}
@@ -141,7 +141,10 @@ class JaxAllocateAction(Action):
         metrics.update_kernel_duration("pack", time.perf_counter() - t0)
 
         t0 = time.perf_counter()
-        assignment = run_packed_auto(
+        # executor indirection: in-process kernels, or the compute-plane
+        # sidecar when VTPU_COMPUTE_PLANE is configured (with automatic
+        # in-process fallback when the sidecar is down)
+        assignment = execute_allocate(
             snap, weights=self.weights, gang_rounds=self.gang_rounds
         )
         metrics.update_kernel_duration("execute", time.perf_counter() - t0)

@@ -51,21 +51,21 @@ class JaxPreemptAction(Action):
         return "jax-preempt"
 
     def _device_outcome(self, pk) -> Tuple[np.ndarray, np.ndarray]:
-        """(evicted[V] bool, pipelined_node[P]) via the selected executor,
-        degrading pallas → dense on runtime failure (the same
-        native-path degradation discipline run_packed_auto uses)."""
-        from volcano_tpu.ops.dispatch import select_preempt_executor
-        from volcano_tpu.ops.preempt_pack import preempt_dense
+        """(evicted[V] bool, pipelined_node[P]) via the selected executor
+        — the compute-plane sidecar when configured — degrading pallas →
+        dense on runtime failure (the same native-path degradation
+        discipline run_packed_auto uses)."""
+        from volcano_tpu.ops.kernels import DEFAULT_WEIGHTS
 
-        executor = select_preempt_executor(pk)
-        if executor == "pallas":
-            from volcano_tpu.ops.preempt_pallas import run_preempt_pallas
+        if self.weights == DEFAULT_WEIGHTS:
+            # wire protocol carries no weights — only default-configured
+            # sessions may route through the sidecar
+            from volcano_tpu.ops.executor import execute_preempt
 
-            try:
-                return run_preempt_pallas(pk, weights=self.weights)
-            except Exception as e:  # noqa: BLE001 — degrade, don't abort
-                log.error("pallas preempt failed (%s); dense fallback", e)
-        return preempt_dense(pk, weights=self.weights)
+            return execute_preempt(pk)
+        from volcano_tpu.ops.dispatch import run_preempt_auto
+
+        return run_preempt_auto(pk, weights=self.weights)
 
     def execute(self, ssn: Session) -> None:
         from volcano_tpu.ops.preempt_pack import pack_preempt_session

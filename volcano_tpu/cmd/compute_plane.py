@@ -1,0 +1,52 @@
+"""Compute-plane sidecar entry point.
+
+The scheduler daemon runs the control plane; this process owns the
+device and serves the packed kernels over the versioned socket protocol
+(serving/compute_plane.py).  Colocate it with the accelerator and point
+the scheduler at it via ``VTPU_COMPUTE_PLANE=<socket>``; if it dies the
+scheduler's executors fall back in-process and re-probe.
+
+Usage: python -m volcano_tpu.cmd.compute_plane --socket /run/vtpu.sock
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from volcano_tpu.serving.compute_plane import ComputePlaneServer
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="vtpu-compute-plane")
+    parser.add_argument("--socket", default="/tmp/vtpu-compute-plane.sock")
+    parser.add_argument(
+        "--warmup", action="store_true",
+        help="compile the headline-bucket kernels before serving",
+    )
+    args = parser.parse_args(argv)
+
+    if args.warmup:
+        # populate the jit cache so the first real session doesn't pay
+        # compile latency (~20-40s on TPU)
+        from volcano_tpu.ops.dispatch import run_packed_auto
+        from volcano_tpu.ops.synthetic import generate_snapshot
+
+        t0 = time.time()
+        run_packed_auto(generate_snapshot(n_tasks=4096, n_nodes=1024, gang_size=8))
+        log.info("warmup compile done in %.1fs", time.time() - t0)
+
+    server = ComputePlaneServer(args.socket).start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -138,6 +138,27 @@ def select_preempt_executor(pk) -> str:
     return "dense"
 
 
+def run_preempt_auto(pk, weights: ScoreWeights = DEFAULT_WEIGHTS):
+    """PreemptPacked → (evicted, pipelined), fastest exact path: pallas
+    when eligible, degrading to the dense formulation on runtime
+    failure.  The single copy of the preempt dispatch — used in-process,
+    by the jax-preempt action, and by the compute-plane sidecar."""
+    from volcano_tpu.ops.preempt_pack import preempt_dense
+
+    if select_preempt_executor(pk) == "pallas":
+        from volcano_tpu.ops.preempt_pallas import run_preempt_pallas
+
+        try:
+            return run_preempt_pallas(pk, weights=weights)
+        except Exception as e:  # noqa: BLE001 — degrade, don't abort
+            from volcano_tpu.utils.logging import get_logger
+
+            get_logger(__name__).error(
+                "pallas preempt failed (%s); dense fallback", e
+            )
+    return preempt_dense(pk, weights=weights)
+
+
 def run_packed_auto(
     snap: PackedSnapshot,
     weights: ScoreWeights = DEFAULT_WEIGHTS,

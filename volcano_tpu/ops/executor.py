@@ -1,0 +1,112 @@
+"""Kernel executor indirection: in-process device kernels, or the
+compute-plane sidecar when one is configured.
+
+``VTPU_COMPUTE_PLANE=<socket path>`` (or ``configure(path)``) routes the
+packed kernels over the serialized boundary
+(serving/compute_plane.py).  Every remote failure — sidecar down,
+timeout, protocol error — falls back to the in-process executor and
+marks the sidecar unhealthy; a background-free probe-on-next-session
+retries it, so a bounced sidecar is picked back up without operator
+action.  Semantics are identical either way (the sidecar runs the same
+run_packed_auto / preempt dispatch on the same packed arrays).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: seconds to wait before re-probing an unhealthy sidecar
+_RETRY_PERIOD = 5.0
+
+
+class _Remote:
+    def __init__(self, path: str):
+        from volcano_tpu.serving.compute_plane import ComputePlaneClient
+
+        self.client = ComputePlaneClient(path)
+        self.path = path
+        self.healthy = True
+        self.last_probe = 0.0
+
+    def usable(self) -> bool:
+        if self.healthy:
+            return True
+        now = time.monotonic()
+        if now - self.last_probe < _RETRY_PERIOD:
+            return False
+        self.last_probe = now
+        self.healthy = self.client.health()
+        if self.healthy:
+            log.info("compute plane %s back up", self.path)
+        return self.healthy
+
+
+_UNSET = object()  # env-derived default; distinct from "explicitly off"
+_remote: object = _UNSET
+
+
+def configure(socket_path: Optional[str]) -> None:
+    """Point the executors at a sidecar.  ``None`` explicitly DISABLES
+    the remote path — including a VTPU_COMPUTE_PLANE env setting."""
+    global _remote
+    _remote = _Remote(socket_path) if socket_path else None
+
+
+def _get_remote() -> Optional[_Remote]:
+    global _remote
+    if _remote is _UNSET:
+        path = os.environ.get("VTPU_COMPUTE_PLANE", "")
+        _remote = _Remote(path) if path else None
+    return _remote
+
+
+def execute_allocate(snap, weights=None, gang_rounds: int = 3) -> np.ndarray:
+    """PackedSnapshot → assignment, via sidecar when configured."""
+    from volcano_tpu.ops.dispatch import run_packed_auto
+    from volcano_tpu.ops.kernels import DEFAULT_WEIGHTS
+
+    weights = weights or DEFAULT_WEIGHTS
+    remote = _get_remote()
+    # the wire protocol carries neither weights nor gang_rounds — only
+    # default-configured sessions may route remotely, or the sidecar
+    # would silently run different parameters than the fallback
+    if (
+        remote is not None
+        and weights == DEFAULT_WEIGHTS
+        and gang_rounds == 3
+        and remote.usable()
+    ):
+        try:
+            return remote.client.allocate(snap)
+        except Exception as e:  # noqa: BLE001 — degrade to in-process
+            remote.healthy = False
+            remote.last_probe = time.monotonic()
+            log.error(
+                "compute plane allocate failed (%s); in-process fallback", e
+            )
+    return run_packed_auto(snap, weights=weights, gang_rounds=gang_rounds)
+
+
+def execute_preempt(pk) -> Tuple[np.ndarray, np.ndarray]:
+    """PreemptPacked → (evicted, pipelined), via sidecar when configured."""
+    from volcano_tpu.ops.dispatch import run_preempt_auto
+
+    remote = _get_remote()
+    if remote is not None and remote.usable():
+        try:
+            return remote.client.preempt(pk)
+        except Exception as e:  # noqa: BLE001
+            remote.healthy = False
+            remote.last_probe = time.monotonic()
+            log.error(
+                "compute plane preempt failed (%s); in-process fallback", e
+            )
+    return run_preempt_auto(pk)

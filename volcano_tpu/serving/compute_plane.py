@@ -1,0 +1,308 @@
+"""Compute-plane boundary: a versioned wire protocol + sidecar serving
+the device kernels over a Unix socket.
+
+The north-star architecture (SURVEY §7) separates the control plane
+(cache/session/actions — event plumbing) from the compute plane (the
+packed device kernels) with a serialized boundary, the way the
+reference's scheduler talks to the API server as its only bus
+(pkg/scheduler/cache/cache.go:321-427 sits on the far side of a
+network boundary).  This module is that boundary:
+
+  * wire format: length-prefixed frames, ``VTPU`` magic + u16 version +
+    u16 message type + u32 payload length.  Payloads are a JSON meta
+    header (scalars, flags, field manifest) + raw little-endian array
+    bytes in manifest order — deterministic, versioned, and free of
+    pickle (untrusted peers cannot execute code).
+  * ``ComputePlaneServer``: accepts connections, deserializes a
+    PackedSnapshot / PreemptPacked, runs the local auto-dispatched
+    executors, returns the assignment / (evicted, pipelined).
+  * ``ComputePlaneClient``: ships a session, with ``health()`` probing
+    and hard timeouts.  Callers (ops/executor.py) fall back to the
+    in-process executor when the sidecar is down — semantics never
+    degrade below the local path.
+
+Run the sidecar with ``python -m volcano_tpu.cmd.compute_plane``.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+MAGIC = b"VTPU"
+VERSION = 1
+
+T_ALLOC_REQ = 1
+T_ALLOC_RESP = 2
+T_PREEMPT_REQ = 3
+T_PREEMPT_RESP = 4
+T_PING = 5
+T_PONG = 6
+T_ERROR = 7
+
+_HEADER = struct.Struct("<4sHHI")
+
+#: PackedSnapshot array fields shipped across the boundary (uids/names
+#: stay host-side — assignments are positional)
+_SNAP_ARRAYS = (
+    "tolerance", "task_resreq", "task_job", "task_sel_bits",
+    "task_tol_bits", "node_idle", "node_used", "node_alloc",
+    "node_label_bits", "node_taint_bits", "node_ok", "node_task_count",
+    "node_max_tasks", "job_min_available", "job_ready_count",
+    "task_has_preferences",
+)
+_SNAP_META = ("n_tasks", "n_nodes", "n_jobs", "needs_host_validation",
+              "memory_exact")
+
+
+def _pack_arrays(meta: Dict, arrays: Dict[str, np.ndarray]) -> bytes:
+    manifest = []
+    blobs = []
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        manifest.append([name, str(arr.dtype), list(arr.shape)])
+        blobs.append(arr.tobytes())
+    head = json.dumps({"meta": meta, "arrays": manifest}).encode()
+    return struct.pack("<I", len(head)) + head + b"".join(blobs)
+
+
+def _unpack_arrays(payload: bytes) -> Tuple[Dict, Dict[str, np.ndarray]]:
+    (hlen,) = struct.unpack_from("<I", payload, 0)
+    head = json.loads(payload[4 : 4 + hlen].decode())
+    arrays: Dict[str, np.ndarray] = {}
+    off = 4 + hlen
+    for name, dtype, shape in head["arrays"]:
+        dt = np.dtype(dtype)
+        n = int(np.prod(shape)) if shape else 1
+        nbytes = n * dt.itemsize
+        arrays[name] = np.frombuffer(
+            payload[off : off + nbytes], dtype=dt
+        ).reshape(shape).copy()
+        off += nbytes
+    return head["meta"], arrays
+
+
+def serialize_snapshot(snap) -> bytes:
+    meta = {k: getattr(snap, k) for k in _SNAP_META}
+    meta["resource_names"] = list(snap.resource_names)
+    arrays = {k: getattr(snap, k) for k in _SNAP_ARRAYS}
+    return _pack_arrays(meta, arrays)
+
+
+def deserialize_snapshot(payload: bytes):
+    from volcano_tpu.ops.packing import PackedSnapshot
+
+    meta, arrays = _unpack_arrays(payload)
+    snap = PackedSnapshot()
+    for k in _SNAP_META:
+        setattr(snap, k, meta[k])
+    snap.resource_names = list(meta["resource_names"])
+    for k, v in arrays.items():
+        setattr(snap, k, v)
+    return snap
+
+
+_PK_ARRAYS = (
+    "node_fi0", "vic_resreq", "vic_node", "vic_job", "job_prio",
+    "job_min_avail", "job_ready0", "job_waiting0", "job_queue",
+    "job_ptask_start", "job_ptask_end", "schedule",
+)
+_PK_META = ("n_victims", "n_jobs")
+
+
+def serialize_preempt(pk) -> bytes:
+    base = serialize_snapshot(pk.base)
+    meta = {k: int(getattr(pk, k)) for k in _PK_META}
+    extra = _pack_arrays(meta, {k: getattr(pk, k) for k in _PK_ARRAYS})
+    return struct.pack("<I", len(base)) + base + extra
+
+
+def deserialize_preempt(payload: bytes):
+    from volcano_tpu.ops.preempt_pack import PreemptPacked
+
+    (blen,) = struct.unpack_from("<I", payload, 0)
+    base = deserialize_snapshot(payload[4 : 4 + blen])
+    meta, arrays = _unpack_arrays(payload[4 + blen :])
+    pk = PreemptPacked(base=base)
+    for k in _PK_META:
+        setattr(pk, k, meta[k])
+    for k, v in arrays.items():
+        setattr(pk, k, v)
+    # positional aliases the kernels index with (uids stay host-side)
+    pk.vic_uids = [str(i) for i in range(pk.n_victims)]
+    pk.vic_names = list(pk.vic_uids)
+    pk.ptask_uids = [str(i) for i in range(base.n_tasks)]
+    pk.node_names = [str(i) for i in range(base.n_nodes)]
+    pk.job_uids = [str(i) for i in range(pk.n_jobs)]
+    return pk
+
+
+def _send_frame(sock: socket.socket, mtype: int, payload: bytes) -> None:
+    sock.sendall(_HEADER.pack(MAGIC, VERSION, mtype, len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
+    head = _recv_exact(sock, _HEADER.size)
+    magic, version, mtype, length = _HEADER.unpack(head)
+    if magic != MAGIC:
+        raise ValueError("bad magic")
+    if version != VERSION:
+        raise ValueError(f"unsupported compute-plane version {version}")
+    return mtype, _recv_exact(sock, length)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):  # one connection, many requests
+        while True:
+            try:
+                mtype, payload = _recv_frame(self.request)
+            except (ConnectionError, OSError):
+                return
+            except ValueError as e:
+                _send_frame(self.request, T_ERROR, str(e).encode())
+                return
+            try:
+                if mtype == T_PING:
+                    _send_frame(self.request, T_PONG, b"")
+                elif mtype == T_ALLOC_REQ:
+                    from volcano_tpu.ops.dispatch import run_packed_auto
+
+                    snap = deserialize_snapshot(payload)
+                    assignment = run_packed_auto(snap)
+                    _send_frame(
+                        self.request, T_ALLOC_RESP,
+                        _pack_arrays({}, {"assignment": assignment}),
+                    )
+                elif mtype == T_PREEMPT_REQ:
+                    from volcano_tpu.ops.dispatch import run_preempt_auto
+
+                    pk = deserialize_preempt(payload)
+                    ev, pipe = run_preempt_auto(pk)
+                    _send_frame(
+                        self.request, T_PREEMPT_RESP,
+                        _pack_arrays({}, {"evicted": np.asarray(ev),
+                                          "pipelined": np.asarray(pipe)}),
+                    )
+                else:
+                    _send_frame(
+                        self.request, T_ERROR, f"unknown type {mtype}".encode()
+                    )
+            except Exception as e:  # noqa: BLE001 — report, keep serving
+                log.error("compute-plane request failed: %s", e)
+                try:
+                    _send_frame(self.request, T_ERROR, str(e).encode())
+                except OSError:
+                    return
+
+
+class ComputePlaneServer:
+    """Threaded Unix-socket sidecar serving the device kernels."""
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self._server: Optional[socketserver.ThreadingUnixStreamServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ComputePlaneServer":
+        import os
+
+        try:
+            os.unlink(self.socket_path)
+        except FileNotFoundError:
+            pass
+        self._server = socketserver.ThreadingUnixStreamServer(
+            self.socket_path, _Handler
+        )
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="vtpu-compute-plane",
+            daemon=True,
+        )
+        self._thread.start()
+        log.info("compute plane serving on %s", self.socket_path)
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+class ComputePlaneClient:
+    """Client side of the boundary; one persistent connection with
+    reconnect-on-error, hard timeouts, and a cheap health probe."""
+
+    def __init__(self, socket_path: str, timeout: float = 120.0):
+        # default above the ~20-40s first-compile latency a cold sidecar
+        # pays per bucket shape (cmd/compute_plane.py --warmup avoids it)
+        self.socket_path = socket_path
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(self.timeout)
+            s.connect(self.socket_path)
+            self._sock = s
+        return self._sock
+
+    def _roundtrip(self, mtype: int, payload: bytes) -> Tuple[int, bytes]:
+        with self._lock:
+            try:
+                sock = self._connect()
+                _send_frame(sock, mtype, payload)
+                return _recv_frame(sock)
+            except Exception:
+                self.close()
+                raise
+
+    def health(self) -> bool:
+        try:
+            mtype, _ = self._roundtrip(T_PING, b"")
+            return mtype == T_PONG
+        except Exception:  # noqa: BLE001
+            return False
+
+    def allocate(self, snap) -> np.ndarray:
+        mtype, payload = self._roundtrip(T_ALLOC_REQ, serialize_snapshot(snap))
+        if mtype == T_ERROR:
+            raise RuntimeError(f"compute plane: {payload.decode()}")
+        _, arrays = _unpack_arrays(payload)
+        return arrays["assignment"]
+
+    def preempt(self, pk) -> Tuple[np.ndarray, np.ndarray]:
+        mtype, payload = self._roundtrip(T_PREEMPT_REQ, serialize_preempt(pk))
+        if mtype == T_ERROR:
+            raise RuntimeError(f"compute plane: {payload.decode()}")
+        _, arrays = _unpack_arrays(payload)
+        return arrays["evicted"].astype(bool), arrays["pipelined"]
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
