@@ -124,6 +124,11 @@ def select_preempt_executor(pk) -> str:
     area = max(base.n_tasks, 1) * max(base.n_nodes, 1)
     if area < _SMALL_AREA:
         return "dense"
+    # the Pallas kernel models the classic {priority, gang, conformance}
+    # preemptable tier only; drf-preemptable (and weakened-filter)
+    # sessions run the dense formulation
+    if not (pk.use_prio and pk.use_gang and pk.use_conf) or pk.use_drf:
+        return "dense"
     if preempt_f32_exact(pk) and _tpu_available():
         from volcano_tpu.ops.preempt_pallas import (
             preempt_smem_bytes,

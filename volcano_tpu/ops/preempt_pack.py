@@ -86,6 +86,30 @@ class PreemptPacked:
     ptask_uids: List[str] = field(default_factory=list)
     node_names: List[str] = field(default_factory=list)
 
+    # enabled-preemptable tier flags (which filters the dense replay
+    # applies); the classic {priority, gang, conformance} triple is the
+    # only shape the Pallas kernel models — drf routes to dense
+    use_prio: bool = True
+    use_gang: bool = True
+    use_conf: bool = True
+    use_drf: bool = False
+
+    # DRF-preemptable state (drf.go:120-221, non-namespace policy):
+    # per-job allocated lanes + cluster total at session open, and each
+    # victim's rank within its node's uid-sorted candidate list (the
+    # order the per-node preemptable call subtracts in).  total_lanes
+    # marks lanes present in total.resource_names() — the share max
+    # iterates only those (a task-only scalar never contributes).
+    job_alloc0: np.ndarray = None  # [J, R] f64
+    total_res: np.ndarray = None  # [R] f64
+    total_lanes: np.ndarray = None  # [R] bool
+    vic_uid_pos: np.ndarray = None  # [V] i32
+    #: False for conformance-critical victims packed ONLY so DRF's
+    #: running subtraction sees them (the host's plugins each scan the
+    #: FULL preemptees list; conformance removes critical tasks from the
+    #: eviction intersection but not from DRF's share arithmetic)
+    vic_evictable: np.ndarray = None  # [V] bool
+
 
 def _cmp_from_less(less):
     def cmp(a, b):
@@ -143,17 +167,19 @@ def collect_preempt_work(ssn):
     return queues, starving, tasks, under_request
 
 
-#: The preemptable intersection the dense/Pallas formulations hardcode
-#: (priority ∩ gang, with conformance handled by the critical-victim
-#: filter below).  Sessions whose first enabled-preemptable tier differs
-#: would silently diverge — pack refuses them instead.
-_SUPPORTED_PREEMPTABLE = {"priority", "gang", "conformance"}
+#: Preemptable plugins the dense formulation can express as filters.
+#: DRF (non-namespace policy) is dense-only; the Pallas kernel models
+#: the classic {priority, gang, conformance} triple.  Anything else in
+#: the first enabled-preemptable tier would silently diverge — pack
+#: refuses it instead.
+_SUPPORTED_PREEMPTABLE = {"priority", "gang", "conformance", "drf"}
 
 
-def _check_preemptable_tiers(ssn) -> None:
-    """Raise unless the first tier with enabled preemptable plugins is
-    exactly the {priority, gang, conformance} intersection the dense
-    formulation encodes (ADVICE r2: fail loudly, not wrongly)."""
+def _check_preemptable_tiers(ssn) -> dict:
+    """Return the enabled-filter flags for the first tier with
+    preemptable plugins; raise when that tier contains anything the
+    dense formulation cannot express (ADVICE r2: fail loudly, not
+    wrongly)."""
     for tier in ssn.tiers:
         enabled = {
             p.name
@@ -162,19 +188,38 @@ def _check_preemptable_tiers(ssn) -> None:
             and p.name in ssn.preemptable_fns
         }
         if enabled:
-            if enabled != _SUPPORTED_PREEMPTABLE:
+            if not enabled <= _SUPPORTED_PREEMPTABLE:
                 raise ValueError(
-                    "dense preempt formulation supports preemptable tier "
+                    "dense preempt formulation supports preemptable plugins "
                     f"{sorted(_SUPPORTED_PREEMPTABLE)}, session has "
                     f"{sorted(enabled)}"
                 )
-            return
+            if "drf" in enabled:
+                drf = ssn.plugins.get("drf")
+                if drf is None or not hasattr(drf, "job_attrs"):
+                    raise ValueError("drf preemptable without plugin state")
+                # the weighted-namespace policy (drf.go:127-175) only
+                # bites when preemptor and preemptee namespaces differ —
+                # single-namespace sessions reduce to the job-share
+                # policy the dense replay models
+                namespaces = {j.namespace for j in ssn.jobs.values()}
+                if drf.namespace_opts and len(namespaces) > 1:
+                    raise ValueError(
+                        "weighted-namespace DRF preemption across "
+                        "namespaces is host-only"
+                    )
+            return {
+                "use_prio": "priority" in enabled,
+                "use_gang": "gang" in enabled,
+                "use_conf": "conformance" in enabled,
+                "use_drf": "drf" in enabled,
+            }
     raise ValueError("session has no enabled preemptable plugins")
 
 
 def pack_preempt_session(ssn) -> PreemptPacked:
     """Session → PreemptPacked (order replay happens here, host-side)."""
-    _check_preemptable_tiers(ssn)
+    flags = _check_preemptable_tiers(ssn)
     queues, starving, ptasks_by_job, under_request = collect_preempt_work(ssn)
 
     # job table over ALL session jobs (victims may belong to any)
@@ -234,16 +279,26 @@ def pack_preempt_session(ssn) -> PreemptPacked:
 
     vics = []
     for n in nodes:
-        node_vics = [
+        all_vics = [
             t
             for t in sorted(n.tasks.values(), key=lambda t: t.uid)
-            if t.status == TaskStatus.Running
-            and t.job in ssn.jobs
-            # conformance veto applied at pack time: critical victims
-            # never enter the dense/device victim set (conformance.go:45-60)
-            and not _is_critical(t)
+            if t.status == TaskStatus.Running and t.job in ssn.jobs
         ]
-        for t in node_vics:
+        # rank within the node's uid-sorted candidate list — the order
+        # the per-node preemptable call processes (DRF's running
+        # subtraction depends on it, and counts CRITICAL tasks too)
+        uid_pos = {t.uid: i for i, t in enumerate(all_vics)}
+        # conformance veto applied at pack time: critical victims never
+        # enter the evictable set (conformance.go:45-60).  DRF sessions
+        # keep them as subtraction-only participants — the host's DRF
+        # plugin scans the full preemptees list.
+        node_vics = []
+        for t in all_vics:
+            critical = flags["use_conf"] and _is_critical(t)
+            if critical and not flags["use_drf"]:
+                continue
+            node_vics.append((t, not critical))
+        for t, _ in node_vics:
             vquid = starving_uids.get(t.job)
             if vquid is not None and len(starving.get(vquid, [])) >= 2:
                 raise ValueError(
@@ -252,21 +307,45 @@ def pack_preempt_session(ssn) -> PreemptPacked:
                     "would diverge"
                 )
         node_vics = _order_stable(
-            node_vics, lambda l, r: ssn.task_order_fn(r, l)
+            node_vics, lambda l, r: ssn.task_order_fn(r[0], l[0])
         )
-        for t in node_vics:
-            vics.append((node_row[n.name], t))
+        for t, evictable in node_vics:
+            vics.append((node_row[n.name], t, uid_pos[t.uid], evictable))
     V = len(vics)
     pk.n_victims = V
     pk.vic_resreq = np.zeros((max(V, 1), R), dtype=np.float32)
     pk.vic_node = np.zeros(max(V, 1), dtype=np.int32)
     pk.vic_job = np.zeros(max(V, 1), dtype=np.int32)
-    for i, (nrow, t) in enumerate(vics):
+    pk.vic_uid_pos = np.zeros(max(V, 1), dtype=np.int32)
+    pk.vic_evictable = np.ones(max(V, 1), dtype=bool)
+    for i, (nrow, t, upos, evictable) in enumerate(vics):
         pk.vic_resreq[i] = _res_vec(t.resreq, names, base)
         pk.vic_node[i] = nrow
         pk.vic_job[i] = job_row[t.job]
+        pk.vic_uid_pos[i] = upos
+        pk.vic_evictable[i] = evictable
         pk.vic_uids.append(t.uid)
         pk.vic_names.append(f"{t.namespace}/{t.name}")
+
+    pk.use_prio = flags["use_prio"]
+    pk.use_gang = flags["use_gang"]
+    pk.use_conf = flags["use_conf"]
+    pk.use_drf = flags["use_drf"]
+    if flags["use_drf"]:
+        drf = ssn.plugins["drf"]
+        pk.job_alloc0 = np.zeros((len(jobs), R), dtype=np.float64)
+        for i, j in enumerate(jobs):
+            attr = drf.job_attrs.get(j.uid)
+            if attr is not None:
+                pk.job_alloc0[i] = _res_vec(attr.allocated, names, base)
+        pk.total_res = _res_vec(drf.total_resource, names, base).astype(
+            np.float64
+        )
+        pk.total_lanes = np.array(
+            [True, True]
+            + [name in drf.total_resource.scalars for name in names[2:]],
+            dtype=bool,
+        )
 
     J = len(jobs)
     pk.n_jobs = J
@@ -357,6 +436,29 @@ def preempt_dense(
     ready = pk.job_ready0.copy()
     waiting = pk.job_waiting0.copy()
     cursor = pk.job_ptask_start.copy()
+    # DRF-preemptable live state: job allocated lanes move with every
+    # evict (on_deallocate) / pipeline (on_allocate), drf.go:255-291
+    job_alloc = pk.job_alloc0.copy() if pk.use_drf else None
+    if pk.use_drf:
+        drf_order = np.lexsort(
+            (pk.vic_uid_pos[:V], pk.vic_job[:V], pk.vic_node[:V])
+        )
+
+    def _share_max(alloc_lanes: np.ndarray) -> np.ndarray:
+        """share = max over total.resource_names() lanes of alloc/total
+        with the reference's zero conventions (drf.go:299-311 via
+        share_fn).  ``alloc_lanes`` is [..., R]."""
+        total = pk.total_res
+        with np.errstate(divide="ignore", invalid="ignore"):
+            frac = np.where(
+                total > 0,
+                alloc_lanes / np.where(total > 0, total, 1.0),
+                np.where(alloc_lanes > 0, 1.0, 0.0),
+            )
+        frac = np.where(pk.total_lanes, frac, -np.inf)
+        # the reference's accumulator starts at 0.0 (`if s > res`), so
+        # all-negative lane shares clamp to zero
+        return np.maximum(frac.max(axis=-1), 0.0)
     # pod-count predicate state: pipeline adds the task to the node's
     # task map (count +1); evict only flips status, count unchanged
     ncount = base.node_task_count[:N].astype(np.int64)
@@ -376,21 +478,56 @@ def preempt_dense(
         # both phases — priority admits strictly-lower-priority JOBS, so
         # the intra-job phase (same job ⇒ equal priority) can never evict
         # while the priority plugin is enabled, matching the host.
-        prio_ok = pk.job_prio[pk.vic_job] < pk.job_prio[pjob]
         if same_job:
-            filt = alive & (pk.vic_job == pjob) & prio_ok
+            cand = alive & (pk.vic_job == pjob)
         else:
-            filt = (
+            cand = (
                 alive
                 & (pk.job_queue[pk.vic_job] == pk.job_queue[pjob])
                 & (pk.vic_job != pjob)
-                & prio_ok
             )
-        # gang: victim's job must stay >= minAvailable (per-job boolean)
-        gang_ok = (pk.job_min_avail[pk.vic_job] <= ready[pk.vic_job] - 1) | (
-            pk.job_min_avail[pk.vic_job] == 1
-        )
-        elig = filt & gang_ok
+        elig = cand
+        if pk.vic_evictable is not None:
+            elig = elig & pk.vic_evictable
+        if pk.use_prio:
+            elig = elig & (pk.job_prio[pk.vic_job] < pk.job_prio[pjob])
+        if pk.use_gang:
+            # gang: victim's job must stay >= minAvailable
+            elig = elig & (
+                (pk.job_min_avail[pk.vic_job] <= ready[pk.vic_job] - 1)
+                | (pk.job_min_avail[pk.vic_job] == 1)
+            )
+        if pk.use_drf and cand.any():
+            # drf.go:180-199: ls = preemptor-job share with the task
+            # added; per candidate IN THE PER-NODE UID ORDER, subtract
+            # its resreq from a running same-(node, job) clone and admit
+            # while ls < rs (or within SHARE_DELTA).  Candidates the
+            # other plugins veto still participate in the subtraction —
+            # the plugins each scan the full preemptees list.
+            ls = float(
+                _share_max(job_alloc[pjob] + resreq.astype(np.float64))
+            )
+            order = drf_order[cand[drf_order]]
+            vals = pk.vic_resreq[order].astype(np.float64)
+            cs = np.cumsum(vals, axis=0)
+            vn2, vj2 = pk.vic_node[order], pk.vic_job[order]
+            new_grp = np.concatenate(
+                [[True], (vn2[1:] != vn2[:-1]) | (vj2[1:] != vj2[:-1])]
+            )
+            starts = np.flatnonzero(new_grp)
+            run_start = np.repeat(
+                starts, np.diff(np.append(starts, order.shape[0]))
+            )
+            offs = np.where(
+                (run_start > 0)[:, None], cs[np.maximum(run_start - 1, 0)], 0.0
+            )
+            alloc_at = job_alloc[vj2] - (cs - offs)
+            rs = _share_max(alloc_at)
+            from volcano_tpu.plugins.drf import SHARE_DELTA
+
+            drf_ok = np.zeros(V, dtype=bool)
+            drf_ok[order] = (ls < rs) | (np.abs(ls - rs) <= SHARE_DELTA)
+            elig = elig & drf_ok
         if V == 0 or not elig.any():
             return False
 
@@ -425,12 +562,16 @@ def preempt_dense(
             evicted[v] = True
             fi[n_star] += pk.vic_resreq[v]
             ready[pk.vic_job[v]] -= 1
+            if job_alloc is not None:  # drf on_deallocate
+                job_alloc[pk.vic_job[v]] -= pk.vic_resreq[v].astype(np.float64)
         if not _fit(resreq, fi[n_star], tol):
             return False
         # pipeline
         fi[n_star] -= resreq
         ncount[n_star] += 1
         waiting[pjob] += 1
+        if job_alloc is not None:  # drf on_allocate for the pipelined task
+            job_alloc[pjob] += resreq.astype(np.float64)
         pipelined_node[p] = n_star
         return True
 
@@ -443,6 +584,7 @@ def preempt_dense(
             saved = (
                 fi.copy(), alive.copy(), ready.copy(), waiting.copy(),
                 evicted.copy(), pipelined_node.copy(), ncount.copy(),
+                job_alloc.copy() if job_alloc is not None else None,
             )
             while cursor[j] < pk.job_ptask_end[j]:
                 if job_pipelined(j):
@@ -455,6 +597,7 @@ def preempt_dense(
                     saved[0], saved[1], saved[2], saved[3], saved[4], saved[5],
                     saved[6],
                 )
+                job_alloc = saved[7]
         else:
             # under-request sweep: unconditional commit, stop at first
             # unassigned task (preempt.go:96-112)

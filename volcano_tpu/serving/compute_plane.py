@@ -116,14 +116,26 @@ _PK_ARRAYS = (
     "node_fi0", "vic_resreq", "vic_node", "vic_job", "job_prio",
     "job_min_avail", "job_ready0", "job_waiting0", "job_queue",
     "job_ptask_start", "job_ptask_end", "schedule",
+    # optional (None outside DRF sessions / older packs) — the manifest
+    # only lists arrays that are present
+    "vic_uid_pos", "vic_evictable", "job_alloc0", "total_res",
+    "total_lanes",
 )
 _PK_META = ("n_victims", "n_jobs")
+_PK_FLAGS = ("use_prio", "use_gang", "use_conf", "use_drf")
 
 
 def serialize_preempt(pk) -> bytes:
     base = serialize_snapshot(pk.base)
     meta = {k: int(getattr(pk, k)) for k in _PK_META}
-    extra = _pack_arrays(meta, {k: getattr(pk, k) for k in _PK_ARRAYS})
+    for k in _PK_FLAGS:
+        meta[k] = bool(getattr(pk, k))
+    arrays = {
+        k: getattr(pk, k)
+        for k in _PK_ARRAYS
+        if getattr(pk, k) is not None
+    }
+    extra = _pack_arrays(meta, arrays)
     return struct.pack("<I", len(base)) + base + extra
 
 
@@ -136,6 +148,11 @@ def deserialize_preempt(payload: bytes):
     pk = PreemptPacked(base=base)
     for k in _PK_META:
         setattr(pk, k, meta[k])
+    for k in _PK_FLAGS:
+        # absent in frames from older peers → dataclass defaults (the
+        # classic triple), matching their pack-time guarantees
+        if k in meta:
+            setattr(pk, k, bool(meta[k]))
     for k, v in arrays.items():
         setattr(pk, k, v)
     # positional aliases the kernels index with (uids stay host-side)
