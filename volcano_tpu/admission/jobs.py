@@ -2,6 +2,15 @@
 
 Reference: pkg/admission/jobs/mutate/mutate_job.go:105-143 and
 jobs/validate/admit_job.go:103-258.
+
+Validation SUBSET note: this module checks job/task naming (DNS-1123),
+replica/minAvailable arithmetic, duplicate task names, policy event/
+action legality (incl. exclusiveness rules), and resource quantity
+syntax.  The reference additionally runs the complete vendored k8s
+PodTemplateSpec validators (admit_job.go:194+ → k8s validation.
+ValidatePodTemplateSpec — full field-by-field pod spec validation);
+pod specs that slip this subset fail at pod-creation time rather than
+at admission.  Documented in README "Known gaps".
 """
 
 from __future__ import annotations
