@@ -10,7 +10,9 @@ submits a gang job and waits for it to run, then exits).
 from __future__ import annotations
 
 import argparse
+import signal
 import sys
+import threading
 import time
 
 from volcano_tpu.apis import core, scheduling
@@ -28,10 +30,21 @@ def _build_node(name: str, cpu: str, mem: str):
 
 
 def local_up(nodes: int = 3, node_cpu: str = "8", node_mem: str = "16Gi",
-             gate_pods: bool = False):
-    """Start the full control plane; returns (api, [daemons])."""
+             gate_pods: bool = False, scheduler_conf: str = "",
+             listen_host: str = "127.0.0.1",
+             admission_port: int = 0, controllers_port: int = 0,
+             scheduler_port: int = 0):
+    """Start the full control plane; returns (api, [daemons]).
+
+    Ports default to 0 (ephemeral) for tests/interactive use; a real
+    deployment (deploy/ renders this entry point as the pod command)
+    passes fixed ports and a routable ``listen_host`` so probes and
+    Services reach the daemons."""
     api = APIServer()
-    admission = AdmissionDaemon(api, gate_pods=gate_pods).start()
+    admission = AdmissionDaemon(
+        api, gate_pods=gate_pods,
+        listen_host=listen_host, listen_port=admission_port,
+    ).start()
     kube = KubeClient(api)
     vc = VolcanoClient(api)
     for i in range(nodes):
@@ -39,8 +52,14 @@ def local_up(nodes: int = 3, node_cpu: str = "8", node_mem: str = "16Gi",
     vc.create_queue(
         scheduling.Queue(metadata=core.ObjectMeta(name="default", namespace=""))
     )
-    controllers = ControllersDaemon(api, period=0.1).start()
-    scheduler = SchedulerDaemon(api, schedule_period=0.2).start()
+    controllers = ControllersDaemon(
+        api, period=0.1,
+        listen_host=listen_host, listen_port=controllers_port,
+    ).start()
+    scheduler = SchedulerDaemon(
+        api, schedule_period=0.2, scheduler_conf=scheduler_conf,
+        listen_host=listen_host, listen_port=scheduler_port,
+    ).start()
     return api, [admission, controllers, scheduler]
 
 
@@ -77,16 +96,36 @@ def _demo(api: APIServer) -> int:
     return 1
 
 
-def main(argv=None) -> int:
+def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="vtpu-local-up")
     parser.add_argument("--nodes", type=int, default=3)
     parser.add_argument("--node-cpu", default="8")
     parser.add_argument("--node-mem", default="16Gi")
     parser.add_argument("--demo", action="store_true",
                         help="submit a gang job, wait for it to run, exit")
-    args = parser.parse_args(argv)
+    parser.add_argument("--serve", action="store_true",
+                        help="run as a daemon until SIGTERM/SIGINT "
+                        "(no interactive prompt; the container mode)")
+    parser.add_argument("--listen-host", default="127.0.0.1")
+    parser.add_argument("--scheduler-port", type=int, default=0)
+    parser.add_argument("--controllers-port", type=int, default=0)
+    parser.add_argument("--admission-port", type=int, default=0)
+    parser.add_argument("--scheduler-conf", default="",
+                        help="scheduler policy YAML, hot-reloaded per cycle")
+    return parser
 
-    api, daemons = local_up(args.nodes, args.node_cpu, args.node_mem)
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    api, daemons = local_up(
+        args.nodes, args.node_cpu, args.node_mem,
+        scheduler_conf=args.scheduler_conf,
+        listen_host=args.listen_host,
+        admission_port=args.admission_port,
+        controllers_port=args.controllers_port,
+        scheduler_port=args.scheduler_port,
+    )
     print(
         "control plane up: admission/controllers/scheduler serving on ports",
         [d.serving.port for d in daemons],
@@ -94,6 +133,12 @@ def main(argv=None) -> int:
     try:
         if args.demo:
             return _demo(api)
+        if args.serve:
+            stop = threading.Event()
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                signal.signal(sig, lambda *_: stop.set())
+            stop.wait()
+            return 0
         from volcano_tpu.cli.vtctl import main as vtctl_main
 
         print("interactive vtctl — e.g. `job list` (ctrl-d to exit)")

@@ -1,0 +1,95 @@
+"""vtpu-package — render the deploy manifests from a values tree.
+
+The ``helm template`` / ``helm install`` equivalent for this build
+(reference: installer/helm/chart/volcano/).  Subcommands:
+
+  vtpu-package template [--values f] [--set a.b=c ...]
+      print the rendered multi-document YAML stream to stdout
+  vtpu-package render -o DIR [--values f] [--set a.b=c ...]
+      write one file per manifest into DIR
+  vtpu-package values
+      print the default values tree (the chart's values.yaml)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from volcano_tpu.deploy.package import (
+    DEFAULT_VALUES,
+    apply_set,
+    load_values,
+    render,
+    render_yaml,
+)
+
+
+def _resolve_values(args) -> dict:
+    values = DEFAULT_VALUES
+    if args.values:
+        with open(args.values, "r", encoding="utf-8") as fh:
+            values = load_values(fh.read())
+    for assignment in args.set or []:
+        values = apply_set(values, assignment)
+    for assignment in getattr(args, "set_string", None) or []:
+        values = apply_set(values, assignment, coerce=False)
+    return values
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="vtpu-package")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    for name in ("template", "render"):
+        p = sub.add_parser(name)
+        p.add_argument("--values", help="values YAML file merged over defaults")
+        p.add_argument("--set", action="append", metavar="KEY=VALUE",
+                       help="override one values path (repeatable)")
+        p.add_argument("--set-string", action="append", metavar="KEY=VALUE",
+                       help="like --set but the value is never coerced "
+                       "(stays a string)")
+        if name == "render":
+            p.add_argument("-o", "--output-dir", required=True)
+
+    sub.add_parser("values")
+
+    args = parser.parse_args(argv)
+
+    if args.command == "values":
+        import yaml
+
+        sys.stdout.write(yaml.safe_dump(DEFAULT_VALUES, sort_keys=False))
+        return 0
+
+    try:
+        values = _resolve_values(args)
+    except (ValueError, OSError) as e:
+        # user-input errors get the one-line CLI treatment, not a trace
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    try:
+        if args.command == "template":
+            sys.stdout.write(render_yaml(values))
+            return 0
+
+        import yaml
+
+        os.makedirs(args.output_dir, exist_ok=True)
+        for fname, manifest in render(values):
+            path = os.path.join(args.output_dir, fname)
+            with open(path, "w", encoding="utf-8") as fh:
+                yaml.safe_dump(manifest, fh, sort_keys=False,
+                               default_flow_style=False)
+            print(path)
+        return 0
+    except OSError as e:
+        # e.g. basic.scheduler_config_file pointing at a missing policy
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,10 @@
+"""Deploy packaging (the installer/helm/chart equivalent)."""
+
+from volcano_tpu.deploy.package import (  # noqa: F401
+    DEFAULT_VALUES,
+    apply_set,
+    load_values,
+    merge_values,
+    render,
+    render_yaml,
+)

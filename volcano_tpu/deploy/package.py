@@ -1,0 +1,297 @@
+"""Parametrized deploy packaging — the helm-chart equivalent.
+
+Reference: installer/helm/chart/volcano/{Chart.yaml,values.yaml,
+templates/{scheduler,controllers,admission}.yaml}.  The reference ships
+a Helm chart whose values.yaml parametrizes image names/tags, the
+admission secret, and the scheduler policy file, and whose templates
+stamp out one Deployment + RBAC per daemon.  This build has no Helm in
+the image and a different topology (the bus is the in-process API
+server, so the three daemons share one Deployment — see
+deploy/kubernetes/volcano-tpu.yaml), so the chart equivalent is a pure
+renderer: a values tree (defaults below, overridable via YAML file and
+``--set`` paths, same precedence helm uses) fed through ``render()``
+into the full manifest set.
+
+Topology rendered:
+  - Namespace
+  - ConfigMap holding the scheduler policy (templates/scheduler.yaml's
+    ``{{ .Files.Glob .Values.basic.scheduler_config_file }}`` inlining)
+  - One Deployment: control-plane container (vtpu-local-up) plus, when
+    ``compute_plane.enabled``, the kernel sidecar container
+    (vtpu-compute-plane) sharing a socket volume — the process boundary
+    from serving/compute_plane.py deployed as a colocated container.
+  - Service exposing scheduler/controllers/admission ports.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Tuple
+
+# Mirrors the reference's values.yaml key shape (basic.image_tag_version
+# etc., installer/helm/chart/volcano/values.yaml:1-9) with TPU-build
+# additions grouped per daemon.
+DEFAULT_VALUES: Dict[str, Any] = {
+    "basic": {
+        "release_name": "volcano-tpu",
+        "namespace": "volcano-tpu-system",
+        "image_name": "volcano-tpu",
+        "image_tag_version": "latest",
+        "image_pull_secret": "",
+        # empty -> the built-in DEFAULT_SCHEDULER_CONF is inlined
+        "scheduler_config_file": "",
+    },
+    "scheduler": {
+        "nodes": 8,
+        "port": 8080,
+    },
+    "controllers": {
+        "port": 8081,
+    },
+    "admission": {
+        "port": 8082,
+    },
+    "compute_plane": {
+        "enabled": True,
+        "socket_dir": "/run/vtpu",
+        "warmup": True,
+        "tpu_resource": "google.com/tpu",
+        "tpu_chips": 8,
+    },
+    "prometheus": {
+        "scrape": True,
+    },
+}
+
+
+def merge_values(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    """Deep-merge ``override`` onto ``base`` (helm's values precedence:
+    later sources win per-key, dicts merge recursively)."""
+    out = copy.deepcopy(base)
+    for key, val in (override or {}).items():
+        if isinstance(val, dict) and isinstance(out.get(key), dict):
+            out[key] = merge_values(out[key], val)
+        elif val is None and out.get(key) is not None:
+            # a bare section header ("compute_plane:") or blank scalar
+            # ("port:") parses as null — keep the default rather than
+            # clobbering the value and crashing render() later
+            continue
+        else:
+            out[key] = copy.deepcopy(val)
+    return out
+
+
+def _coerce(text: str) -> Any:
+    """--set value coercion: helm's scalar parsing (int, bool, string)."""
+    low = text.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(text)
+    except ValueError:
+        return text
+
+
+def apply_set(values: Dict[str, Any], assignment: str,
+              coerce: bool = True) -> Dict[str, Any]:
+    """Apply one ``--set a.b.c=v`` override (helm --set path syntax).
+
+    ``coerce=False`` is the ``--set-string`` escape hatch: the value
+    stays a string even when it looks numeric or boolean."""
+    if "=" not in assignment:
+        raise ValueError(f"--set needs key=value, got {assignment!r}")
+    path, _, raw = assignment.partition("=")
+    keys = [k for k in path.split(".") if k]
+    if not keys:
+        raise ValueError(f"--set has empty key path: {assignment!r}")
+    out = copy.deepcopy(values)
+    node = out
+    for k in keys[:-1]:
+        nxt = node.get(k)
+        if nxt is None:
+            nxt = {}
+            node[k] = nxt
+        elif not isinstance(nxt, dict):
+            # traversing through an existing scalar is a path typo —
+            # surface it here, not as a render-time TypeError
+            raise ValueError(
+                f"--set path {path!r}: {k!r} is a value, not a section")
+        node = nxt
+    node[keys[-1]] = _coerce(raw) if coerce else raw
+    return out
+
+
+def load_values(text: str) -> Dict[str, Any]:
+    """Parse a values YAML document and merge it over the defaults."""
+    import yaml
+
+    raw = yaml.safe_load(text) or {}
+    if not isinstance(raw, dict):
+        raise ValueError("values file must be a YAML mapping")
+    return merge_values(DEFAULT_VALUES, raw)
+
+
+def _scheduler_conf_text(values: Dict[str, Any]) -> str:
+    path = values["basic"].get("scheduler_config_file") or ""
+    if path:
+        with open(path, "r", encoding="utf-8") as fh:
+            return fh.read()
+    # conf's own import of framework.arguments re-enters conf when conf
+    # is imported first; initializing the framework package up front
+    # keeps this module importable standalone
+    import volcano_tpu.framework  # noqa: F401
+    from volcano_tpu.conf import DEFAULT_SCHEDULER_CONF
+
+    return DEFAULT_SCHEDULER_CONF.strip() + "\n"
+
+
+def render(values: Dict[str, Any]) -> List[Tuple[str, Dict[str, Any]]]:
+    """Render the manifest set from a values tree.
+
+    Returns ``[(filename, manifest_dict), ...]`` in apply order, the
+    template expansion the reference delegates to Helm."""
+    basic = values["basic"]
+    name = basic["release_name"]
+    ns = basic["namespace"]
+    image = f"{basic['image_name']}:{basic['image_tag_version']}"
+    cp = values["compute_plane"]
+    sched_port = int(values["scheduler"]["port"])
+    ctrl_port = int(values["controllers"]["port"])
+    adm_port = int(values["admission"]["port"])
+
+    manifests: List[Tuple[str, Dict[str, Any]]] = []
+
+    # filenames carry the apply order — kubectl apply -f DIR walks the
+    # directory lexically, and the Namespace must exist before anything
+    # placed inside it
+    manifests.append(("00-namespace.yaml", {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {"name": ns},
+    }))
+
+    manifests.append(("10-scheduler-configmap.yaml", {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": f"{name}-scheduler-configmap", "namespace": ns},
+        "data": {"volcano-scheduler.conf": _scheduler_conf_text(values)},
+    }))
+
+    labels = {"app": name}
+    annotations: Dict[str, str] = {}
+    if values["prometheus"]["scrape"]:
+        annotations = {
+            "prometheus.io/scrape": "true",
+            "prometheus.io/port": str(sched_port),
+        }
+
+    control_plane: Dict[str, Any] = {
+        "name": "control-plane",
+        "image": image,
+        # --serve: daemon mode (a pod's stdin is EOF, the interactive
+        # prompt would exit immediately); 0.0.0.0 + fixed ports so the
+        # kubelet probe and the Service actually reach the daemons
+        "command": [
+            "vtpu-local-up", "--serve",
+            "--nodes", str(values["scheduler"]["nodes"]),
+            "--listen-host", "0.0.0.0",
+            "--scheduler-port", str(sched_port),
+            "--controllers-port", str(ctrl_port),
+            "--admission-port", str(adm_port),
+            "--scheduler-conf", "/etc/volcano-tpu/volcano-scheduler.conf",
+        ],
+        "volumeMounts": [
+            {"name": "scheduler-config", "mountPath": "/etc/volcano-tpu"},
+        ],
+        "livenessProbe": {
+            "httpGet": {"path": "/healthz", "port": sched_port},
+            "periodSeconds": 10,
+        },
+        "ports": [
+            {"containerPort": sched_port, "name": "scheduler"},
+            {"containerPort": ctrl_port, "name": "controllers"},
+            {"containerPort": adm_port, "name": "admission"},
+        ],
+    }
+    containers = [control_plane]
+    volumes: List[Dict[str, Any]] = [
+        {"name": "scheduler-config",
+         "configMap": {"name": f"{name}-scheduler-configmap"}},
+    ]
+
+    if cp["enabled"]:
+        socket = f"{cp['socket_dir']}/compute-plane.sock"
+        control_plane["env"] = [{"name": "VTPU_COMPUTE_PLANE", "value": socket}]
+        control_plane["volumeMounts"].append(
+            {"name": "compute-plane-socket", "mountPath": cp["socket_dir"]})
+        sidecar_cmd = ["vtpu-compute-plane", "--socket", socket]
+        if cp["warmup"]:
+            sidecar_cmd.append("--warmup")
+        containers.append({
+            "name": "compute-plane",
+            "image": image,
+            "command": sidecar_cmd,
+            "volumeMounts": [
+                {"name": "compute-plane-socket", "mountPath": cp["socket_dir"]},
+            ],
+            "resources": {
+                "limits": {cp["tpu_resource"]: str(cp["tpu_chips"])},
+            },
+        })
+        volumes.append({"name": "compute-plane-socket", "emptyDir": {}})
+    else:
+        # in-process kernels: the control plane itself owns the device,
+        # so the TPU limit moves onto it (the single-container topology
+        # of deploy/kubernetes/volcano-tpu.yaml)
+        control_plane["resources"] = {
+            "limits": {cp["tpu_resource"]: str(cp["tpu_chips"])},
+        }
+
+    pod_spec: Dict[str, Any] = {"containers": containers, "volumes": volumes}
+    if basic.get("image_pull_secret"):
+        pod_spec["imagePullSecrets"] = [{"name": basic["image_pull_secret"]}]
+
+    template_meta: Dict[str, Any] = {"labels": labels}
+    if annotations:
+        template_meta["annotations"] = annotations
+
+    manifests.append(("20-deployment.yaml", {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": ns, "labels": labels},
+        "spec": {
+            # one replica by design: the in-process bus makes the pod the
+            # HA unit; leader election arbitrates daemon threads inside it
+            "replicas": 1,
+            # Recreate: a RollingUpdate surge pod could never schedule —
+            # the old pod holds the node's TPU chips until it dies
+            "strategy": {"type": "Recreate"},
+            "selector": {"matchLabels": labels},
+            "template": {"metadata": template_meta, "spec": pod_spec},
+        },
+    }))
+
+    manifests.append(("30-service.yaml", {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": ns, "labels": labels},
+        "spec": {
+            "selector": labels,
+            "ports": [
+                {"name": "scheduler", "port": sched_port},
+                {"name": "controllers", "port": ctrl_port},
+                {"name": "admission", "port": adm_port},
+            ],
+        },
+    }))
+
+    return manifests
+
+
+def render_yaml(values: Dict[str, Any]) -> str:
+    """The ``helm template`` equivalent: one multi-document YAML stream."""
+    import yaml
+
+    docs = [yaml.safe_dump(m, sort_keys=False, default_flow_style=False)
+            for _, m in render(values)]
+    return "---\n".join(docs)
