@@ -1,0 +1,84 @@
+"""Quantify GC's share of the warm action latency: run the warm action
+with (a) default gc, (b) gc.freeze() of all pre-action survivors, and
+report both plus collection counts."""
+
+from __future__ import annotations
+
+import gc
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import volcano_tpu.actions  # noqa: F401
+import volcano_tpu.plugins  # noqa: F401
+from volcano_tpu.actions.jax_allocate import JaxAllocateAction
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.conf import PluginOption, Tier
+from volcano_tpu.framework import close_session, open_session
+from volcano_tpu.ops.synthetic import generate_cluster_objects
+
+kwargs = dict(n_tasks=50_000, n_nodes=10_000, gang_size=8,
+              label_classes=8, taint_fraction=0.1)
+nodes, pods, pgs, queues = generate_cluster_objects(**kwargs)
+
+TIERS = [
+    Tier(plugins=[PluginOption(name=n) for n in ("priority", "gang")]),
+    Tier(plugins=[
+        PluginOption(name=n)
+        for n in ("drf", "predicates", "proportion", "nodeorder", "binpack")
+    ]),
+]
+
+
+class _ListBinder:
+    def __init__(self):
+        self.binds = []
+
+    def bind(self, task, hostname):
+        self.binds.append((f"{task.namespace}/{task.name}", hostname))
+
+
+def fresh():
+    cache = SchedulerCache(binder=_ListBinder())
+    for n in nodes:
+        cache.add_node(n)
+    for p in pods:
+        cache.add_pod(p)
+    for pg in pgs:
+        cache.add_pod_group(pg)
+    for q in queues:
+        cache.add_queue(q)
+    return cache
+
+
+action = JaxAllocateAction()
+
+
+def one(tag):
+    cache = fresh()
+    t0 = time.perf_counter()
+    ssn = open_session(cache, TIERS, [])
+    t1 = time.perf_counter()
+    action.execute(ssn)
+    t2 = time.perf_counter()
+    close_session(ssn)
+    c0 = gc.get_count()
+    print(f"{tag}: open={t1-t0:.3f}s exec={t2-t1:.3f}s gc_count={c0} "
+          f"collections={[s['collections'] for s in gc.get_stats()]}")
+
+
+one("warmup")
+one("warm-default-gc")
+one("warm-default-gc2")
+
+# simulate accumulated survivors: keep several big caches alive (what the
+# earlier bench configs leave behind), then measure again
+ballast = [fresh() for _ in range(2)]
+one("ballast-default-gc")
+
+gc.collect()
+gc.freeze()
+one("ballast-frozen")
+one("ballast-frozen2")
+del ballast

@@ -83,7 +83,9 @@ class TaskInfo:
         self.name = name
         self.namespace = namespace
         self.resreq = resreq
-        self.init_resreq = init_resreq if init_resreq is not None else resreq.clone()
+        # share, not clone: both attributes are immutable post-construction
+        # (see clone() below)
+        self.init_resreq = init_resreq if init_resreq is not None else resreq
         self.node_name = node_name
         self.status = status
         self.priority = priority
@@ -102,8 +104,14 @@ class TaskInfo:
         t.job = self.job
         t.name = self.name
         t.namespace = self.namespace
-        t.resreq = self.resreq.clone()
-        t.init_resreq = self.init_resreq.clone()
+        # resreq/init_resreq are IMMUTABLE after new_task_info — every
+        # accounting op copies into owner-held accumulators (job.allocated,
+        # node.idle, ...), never mutates a task's request in place
+        # (job_info.go clones here; the invariant makes sharing safe and
+        # removes two Resource copies per placement).  Anything that needs
+        # a different request must REPLACE the attribute, not mutate it.
+        t.resreq = self.resreq
+        t.init_resreq = self.init_resreq
         t.node_name = self.node_name
         t.status = self.status
         t.priority = self.priority

@@ -63,9 +63,11 @@ def main(argv=None) -> int:
         sys.stdout.write(yaml.safe_dump(DEFAULT_VALUES, sort_keys=False))
         return 0
 
+    import yaml
+
     try:
         values = _resolve_values(args)
-    except (ValueError, OSError) as e:
+    except (ValueError, OSError, yaml.YAMLError) as e:
         # user-input errors get the one-line CLI treatment, not a trace
         print(f"error: {e}", file=sys.stderr)
         return 2
