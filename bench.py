@@ -63,14 +63,19 @@ def _time_r(fn, warmup: int = 1, iters: int = 3):
     return float(np.median(times)), result
 
 
-def _relay_floor_s(in_bytes: int = 0, out_elems: int = 1024) -> float:
-    """Harness device-link floor: the time to push ``in_bytes`` of fresh
-    input, run a trivial kernel, and fetch ``out_elems`` int32 — i.e. the
-    cost any session of this shape pays before computing anything.  The
-    dev tunnel adds ~80-110ms of round-trip latency per session;
-    production colocates scheduler and device (PCIe, <1ms for these
-    volumes).  The headline ``value`` stays the UNADJUSTED e2e; the floor
-    and the floor-adjusted compute are reported alongside."""
+def _relay_probe(in_bytes: int = 0, out_elems: int = 1024):
+    """Harness device-link floor probe: a warmed callable timing one
+    push of ``in_bytes`` of fresh input + trivial kernel + fetch of
+    ``out_elems`` int32 — i.e. the cost any session of this shape pays
+    before computing anything.  The dev tunnel adds ~80-110ms of
+    round-trip latency per session; production colocates scheduler and
+    device (PCIe, <1ms for these volumes).  The headline ``value`` stays
+    the UNADJUSTED e2e; the floor and the floor-adjusted compute are
+    reported alongside.  Returned as a probe (not a one-shot
+    measurement) so callers can INTERLEAVE floor samples with session
+    samples — the link is jittery, and floor/session medians from
+    disjoint time windows routinely cross, making compute unmeasurable
+    (r4: config 3/4 compute_ms null)."""
     import jax
     import jax.numpy as jnp
 
@@ -80,13 +85,26 @@ def _relay_floor_s(in_bytes: int = 0, out_elems: int = 1024) -> float:
 
     payload = np.zeros(max(in_bytes // 4, out_elems), dtype=np.float32)
     out = np.zeros(out_elems, dtype=np.float32)
-    np.asarray(trivial(jnp.asarray(payload), jnp.asarray(out)))
-    times = []
-    for _ in range(5):
+    np.asarray(trivial(jnp.asarray(payload), jnp.asarray(out)))  # warm
+
+    def probe() -> float:
         t0 = time.perf_counter()
         np.asarray(trivial(jnp.asarray(payload), jnp.asarray(out)))
-        times.append(time.perf_counter() - t0)
-    return float(np.median(times))
+        return time.perf_counter() - t0
+
+    return probe
+
+
+def _time_interleaved(fn, probe, iters: int = 5):
+    """(median fn seconds, median probe seconds), samples alternating
+    fn/probe so both medians come from the same link-jitter window."""
+    fn_times, probe_times = [], []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        fn_times.append(time.perf_counter() - t0)
+        probe_times.append(probe())
+    return float(np.median(fn_times)), float(np.median(probe_times))
 
 
 def bench_config(name: str, kwargs: dict, iters: int = 5) -> dict:
@@ -114,12 +132,14 @@ def bench_config(name: str, kwargs: dict, iters: int = 5) -> dict:
             + snap.task_resreq.shape[0] * 8
             + snap.node_idle.nbytes * 4
         )
-    relay_s = _relay_floor_s(in_bytes=in_bytes, out_elems=snap.n_tasks)
+    probe = _relay_probe(in_bytes=in_bytes, out_elems=snap.n_tasks)
 
     # Device path: end-to-end host→device→assignment latency.  The
     # headline value and vs_baseline use the UNADJUSTED e2e time; the
     # relay floor is reported alongside (compute_ms) for interpretation.
-    e2e_s = _time(lambda: run_packed(snap), warmup=1, iters=iters)
+    device_assign = run_packed(snap)  # compile warmup + result
+    e2e_s, relay_s = _time_interleaved(
+        lambda: run_packed(snap), probe, iters=iters)
     # The native host executor never touches the device — no relay floor
     # to subtract from its sessions.  The floor is measured moments apart
     # from the session through a jittery link: when it comes out ABOVE
@@ -131,7 +151,6 @@ def bench_config(name: str, kwargs: dict, iters: int = 5) -> dict:
         compute_s = e2e_s - relay_s
     else:
         compute_s = None
-    device_assign = run_packed(snap)
 
     # Native baseline — best of 1-thread and 16-thread (the pooled sweep
     # only wins on some shapes; the reference would use whichever is
@@ -193,7 +212,7 @@ def bench_preempt_config(name: str, kwargs: dict, iters: int = 5) -> dict:
         + pk.vic_node.nbytes * 3
         + pk.base.node_used.nbytes * 5
     )
-    relay_s = _relay_floor_s(in_bytes=in_bytes, out_elems=pk.base.n_tasks)
+    probe = _relay_probe(in_bytes=in_bytes, out_elems=pk.base.n_tasks)
 
     if executor == "pallas":
         from volcano_tpu.ops.preempt_pallas import run_preempt_pallas
@@ -201,7 +220,8 @@ def bench_preempt_config(name: str, kwargs: dict, iters: int = 5) -> dict:
         run = lambda: run_preempt_pallas(pk)
     else:
         run = lambda: preempt_dense(pk)
-    e2e_s, (dev_ev, dev_pipe) = _time_r(run, warmup=1, iters=iters)
+    dev_ev, dev_pipe = run()  # compile warmup + result
+    e2e_s, relay_s = _time_interleaved(run, probe, iters=iters)
     if executor == "dense":
         compute_s = e2e_s
     elif relay_s < e2e_s:

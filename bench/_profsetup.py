@@ -1,0 +1,56 @@
+"""Shared setup for the bench/prof_* scripts: the headline cluster
+shape, tier config, binder, and cache builder — one copy, kept in sync
+with bench.py's action bench so profiling numbers line up with the
+action_latency_* metrics."""
+
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, ".")
+
+import volcano_tpu.actions  # noqa: F401 — registers actions
+import volcano_tpu.plugins  # noqa: F401 — registers plugin builders
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.conf import PluginOption, Tier
+from volcano_tpu.ops.synthetic import BASELINE_CONFIGS, generate_cluster_objects
+
+HEADLINE_KWARGS = dict(BASELINE_CONFIGS["50k_pods_10k_nodes_gang_predicates"])
+
+TIERS = [
+    Tier(plugins=[PluginOption(name=n) for n in ("priority", "gang")]),
+    Tier(plugins=[
+        PluginOption(name=n)
+        for n in ("drf", "predicates", "proportion", "nodeorder", "binpack")
+    ]),
+]
+
+
+class ListBinder:
+    def __init__(self):
+        self.binds = []
+
+    def bind(self, task, hostname):
+        self.binds.append((f"{task.namespace}/{task.name}", hostname))
+
+
+def make_cache_builder(**overrides):
+    """Returns a zero-arg callable building a fresh fed cache of the
+    headline shape (or the shape given by overrides)."""
+    kwargs = dict(HEADLINE_KWARGS)
+    kwargs.update(overrides)
+    nodes, pods, pgs, queues = generate_cluster_objects(**kwargs)
+
+    def fresh():
+        cache = SchedulerCache(binder=ListBinder())
+        for n in nodes:
+            cache.add_node(n)
+        for p in pods:
+            cache.add_pod(p)
+        for pg in pgs:
+            cache.add_pod_group(pg)
+        for q in queues:
+            cache.add_queue(q)
+        return cache
+
+    return fresh

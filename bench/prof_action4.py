@@ -1,59 +1,29 @@
 """Phase breakdown of the CURRENT jax-allocate action (fast_order +
-fast_apply) at the headline shape, warm run, through the bench harness's
-cluster generator so numbers line up with action_latency_* metrics."""
+fast_apply) at the headline shape, warm run (shape args: [tasks [nodes]])."""
 
 from __future__ import annotations
 
 import sys
 import time
 
+sys.path.insert(0, "bench")
 sys.path.insert(0, ".")
 
-import volcano_tpu.actions  # noqa: F401
-import volcano_tpu.plugins  # noqa: F401
-from volcano_tpu.actions.jax_allocate import JaxAllocateAction, compute_task_order
-from volcano_tpu.cache import SchedulerCache
-from volcano_tpu.conf import PluginOption, Tier
-from volcano_tpu.framework import close_session, open_session
-from volcano_tpu.ops.synthetic import generate_cluster_objects
+from _profsetup import TIERS, make_cache_builder  # noqa: E402
 
-n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
-n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+from volcano_tpu.actions.fast_apply import try_fast_apply  # noqa: E402
+from volcano_tpu.actions.jax_allocate import (  # noqa: E402
+    JaxAllocateAction,
+    compute_task_order,
+)
+from volcano_tpu.framework import close_session, open_session  # noqa: E402
 
-kwargs = dict(n_tasks=n_tasks, n_nodes=n_nodes, gang_size=8,
-              label_classes=8, taint_fraction=0.1)
-nodes, pods, pgs, queues = generate_cluster_objects(**kwargs)
-
-TIERS = [
-    Tier(plugins=[PluginOption(name=n) for n in ("priority", "gang")]),
-    Tier(plugins=[
-        PluginOption(name=n)
-        for n in ("drf", "predicates", "proportion", "nodeorder", "binpack")
-    ]),
-]
-
-
-class _ListBinder:
-    def __init__(self):
-        self.binds = []
-
-    def bind(self, task, hostname):
-        self.binds.append((f"{task.namespace}/{task.name}", hostname))
-
-
-def fresh_cache():
-    cache = SchedulerCache(binder=_ListBinder())
-    for n in nodes:
-        cache.add_node(n)
-    for p in pods:
-        cache.add_pod(p)
-    for pg in pgs:
-        cache.add_pod_group(pg)
-    for q in queues:
-        cache.add_queue(q)
-    return cache
-
-
+overrides = {}
+if len(sys.argv) > 1:
+    overrides["n_tasks"] = int(sys.argv[1])
+if len(sys.argv) > 2:
+    overrides["n_nodes"] = int(sys.argv[2])
+fresh_cache = make_cache_builder(**overrides)
 action = JaxAllocateAction()
 
 for run in range(2):  # run 0 = compile warmup
@@ -72,8 +42,6 @@ for run in range(2):  # run 0 = compile warmup
     t0 = time.perf_counter()
     proposals, snap = action._kernel_proposals(ssn, ordered)
     kern_s = time.perf_counter() - t0
-
-    from volcano_tpu.actions.fast_apply import try_fast_apply
 
     t0 = time.perf_counter()
     ok = try_fast_apply(ssn, ordered, proposals, snap)

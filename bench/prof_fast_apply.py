@@ -6,53 +6,20 @@ from __future__ import annotations
 import cProfile
 import pstats
 import sys
-import time
 
+sys.path.insert(0, "bench")
 sys.path.insert(0, ".")
 
-import volcano_tpu.actions  # noqa: F401
-import volcano_tpu.plugins  # noqa: F401
-from volcano_tpu.actions.fast_apply import try_fast_apply
-from volcano_tpu.actions.jax_allocate import JaxAllocateAction, compute_task_order
-from volcano_tpu.cache import SchedulerCache
-from volcano_tpu.conf import PluginOption, Tier
-from volcano_tpu.framework import close_session, open_session
-from volcano_tpu.ops.synthetic import generate_cluster_objects
+from _profsetup import TIERS, make_cache_builder  # noqa: E402
 
-kwargs = dict(n_tasks=50_000, n_nodes=10_000, gang_size=8,
-              label_classes=8, taint_fraction=0.1)
-nodes, pods, pgs, queues = generate_cluster_objects(**kwargs)
+from volcano_tpu.actions.fast_apply import try_fast_apply  # noqa: E402
+from volcano_tpu.actions.jax_allocate import (  # noqa: E402
+    JaxAllocateAction,
+    compute_task_order,
+)
+from volcano_tpu.framework import close_session, open_session  # noqa: E402
 
-TIERS = [
-    Tier(plugins=[PluginOption(name=n) for n in ("priority", "gang")]),
-    Tier(plugins=[
-        PluginOption(name=n)
-        for n in ("drf", "predicates", "proportion", "nodeorder", "binpack")
-    ]),
-]
-
-
-class _ListBinder:
-    def __init__(self):
-        self.binds = []
-
-    def bind(self, task, hostname):
-        self.binds.append((f"{task.namespace}/{task.name}", hostname))
-
-
-def fresh():
-    cache = SchedulerCache(binder=_ListBinder())
-    for n in nodes:
-        cache.add_node(n)
-    for p in pods:
-        cache.add_pod(p)
-    for pg in pgs:
-        cache.add_pod_group(pg)
-    for q in queues:
-        cache.add_queue(q)
-    return cache
-
-
+fresh = make_cache_builder()
 action = JaxAllocateAction()
 
 # warmup (compile)

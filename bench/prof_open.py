@@ -1,0 +1,26 @@
+"""cProfile of open_session at the headline shape (warm second open)."""
+
+from __future__ import annotations
+
+import cProfile
+import pstats
+import sys
+
+sys.path.insert(0, "bench")
+sys.path.insert(0, ".")
+
+from _profsetup import TIERS, make_cache_builder  # noqa: E402
+
+from volcano_tpu.framework import close_session, open_session  # noqa: E402
+
+cache = make_cache_builder()()
+
+ssn = open_session(cache, TIERS, [])
+close_session(ssn)
+
+pr = cProfile.Profile()
+pr.enable()
+ssn = open_session(cache, TIERS, [])
+pr.disable()
+close_session(ssn)
+pstats.Stats(pr).sort_stats("cumulative").print_stats(30)

@@ -261,14 +261,28 @@ class JobInfo:
         return f"pod group is not ready, {', '.join(hist)}."
 
     def clone(self) -> "JobInfo":
+        # Field-level copy (same rationale as NodeInfo.clone): replaying
+        # add_task_info per task re-sums allocated/total_request and
+        # rebuilds the index at ~4µs/task — at 50k tasks that's the
+        # second-largest snapshot cost.  The copy keeps the cache's
+        # incrementally-maintained rollups as-is.
         info = JobInfo(self.uid, self.name, self.namespace)
         info.queue = self.queue
         info.priority = self.priority
         info.min_available = self.min_available
         info.pod_group = self.pod_group
         info.creation_timestamp = self.creation_timestamp
-        for task in self.tasks.values():
-            info.add_task_info(task.clone())
+        info.allocated = self.allocated.clone()
+        info.total_request = self.total_request.clone()
+        tasks = info.tasks
+        index = info.task_status_index
+        for uid, t in self.tasks.items():
+            ti = t.clone()
+            tasks[uid] = ti
+            bucket = index.get(ti.status)
+            if bucket is None:
+                bucket = index[ti.status] = {}
+            bucket[uid] = ti
         return info
 
     def __repr__(self) -> str:

@@ -135,10 +135,27 @@ class NodeInfo:
         self.add_task(task)
 
     def clone(self) -> "NodeInfo":
-        res = NodeInfo(self.node)
-        for task in self.tasks.values():
-            res.add_task(task)
+        # Field-level copy.  The reference clones by replay
+        # (node_info.go: NewNodeInfo + AddTask per task), which re-parses
+        # the node's quantity strings and re-runs per-task accounting —
+        # ~150µs/node, the dominant cost of the session snapshot at 10k
+        # nodes.  The copy keeps the incrementally-maintained accounting
+        # exactly as the cache holds it (replay would also re-normalize
+        # float op order; the cache's sequences are already the canonical
+        # ones — see fast_apply's bit-identity contract).
+        res = NodeInfo.__new__(NodeInfo)
+        res.node = self.node
+        res.name = self.name
+        res.releasing = self.releasing.clone()
+        res.pipelined = self.pipelined.clone()
+        res.used = self.used.clone()
+        res.idle = self.idle.clone()
+        res.allocatable = self.allocatable.clone()
+        res.capability = self.capability.clone()
+        res.tasks = {uid: t.clone() for uid, t in self.tasks.items()}
         res.others = self.others
+        res.phase = self.phase
+        res.reason = self.reason
         return res
 
     @property

@@ -77,8 +77,6 @@ def main(argv=None) -> int:
             sys.stdout.write(render_yaml(values))
             return 0
 
-        import yaml
-
         os.makedirs(args.output_dir, exist_ok=True)
         for fname, manifest in render(values):
             path = os.path.join(args.output_dir, fname)
