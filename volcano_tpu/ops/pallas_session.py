@@ -705,17 +705,22 @@ def pallas_session_payload_bytes(snap: PackedSnapshot, block_size: int = 256) ->
     return 4 + R * 4 + _u_pad(U) * (R + 1) * 4 + T_rows * 4 + 2 * JP * 4
 
 
-def run_packed_pallas(
+def make_session_dispatch(
     snap: PackedSnapshot,
     weights: ScoreWeights = DEFAULT_WEIGHTS,
     gang_rounds: int = 3,
     block_size: int = 256,
     interpret: bool = False,
-) -> np.ndarray:
-    """Host wrapper: PackedSnapshot → assignment[T].  Packs, makes ONE
-    fused device call (gang fixpoint included — schedule_session_pallas),
-    fetches the committed assignment.  The session ships as one byte
-    buffer; cluster planes ride the device-resident cache."""
+    prestage: bool = False,
+):
+    """Pack once; return ``(dispatch, T_act)`` where ``dispatch()``
+    enqueues the fused session kernel and returns the (async) device
+    result.  ``prestage=True`` device_puts the session buffer up front so
+    repeated dispatches measure pure device compute — the bench pipelines
+    K dispatches before one sync to amortize link RTT out of the compute
+    estimate (over the dev tunnel, any per-call sync costs ~100ms, which
+    swamps the kernel).  run_packed_pallas uses prestage=False: the
+    per-session transfer is part of real session latency."""
     if not f32_lr_exact(snap):
         # Outside the f32 floor-division exactness envelope — the caller
         # (run_packed_auto) routes such sessions to the XLA int path.
@@ -747,15 +752,17 @@ def run_packed_pallas(
         taskrow_ext[:, : R + 1] = rows
         taskrow_ext[:n_act, R + 1] = 1.0
         taskrow_ext[:n_tj, R + 2] = snap.task_job[:n_tj].astype(np.float32)
-        out = schedule_session_pallas_packed(
-            jnp.asarray(taskrow_ext),
-            jnp.asarray(arrays["cf_u8"]),
-            jnp.asarray(arrays["nd"]),
-            jnp.asarray(arrays["tol"]),
-            jnp.asarray(jobs2),
-            weights=weights, block_size=block_size,
-            gang_rounds=gang_rounds, interpret=interpret,
-        )
+        args5 = (taskrow_ext, arrays["cf_u8"], arrays["nd"],
+                 arrays["tol"], jobs2)
+        if prestage:
+            args5 = tuple(jax.device_put(jnp.asarray(a)) for a in args5)
+
+        def dispatch():
+            return schedule_session_pallas_packed(
+                *(jnp.asarray(a) for a in args5),
+                weights=weights, block_size=block_size,
+                gang_rounds=gang_rounds, interpret=interpret,
+            )
     else:
         task_job16[:n_tj] = snap.task_job[:n_tj].astype(np.uint16)
         # pad U to a power-of-two bucket: U is a static jit arg AND sizes
@@ -774,17 +781,38 @@ def run_packed_pallas(
             np.ascontiguousarray(jobs2).view(np.uint8).ravel(),
         ])
         cluster = _cached_cluster_buf(arrays["cf_u8"], arrays["nd"])
-        out = schedule_session_pallas_buf(
-            jnp.asarray(session_buf),
-            cluster,
+        if prestage:
+            session_buf = jax.device_put(jnp.asarray(session_buf))
+        kw = dict(
             T_rows=T_rows, R=R, U=U_pad, C=arrays["cf_u8"].shape[0],
             ND=arrays["nd"].shape[0], NS=arrays["nd"].shape[1], JP=JP,
-            weights=weights,
-            block_size=block_size,
-            gang_rounds=gang_rounds,
-            interpret=interpret,
+            weights=weights, block_size=block_size,
+            gang_rounds=gang_rounds, interpret=interpret,
         )
-    out = np.asarray(out)
+
+        def dispatch():
+            return schedule_session_pallas_buf(
+                jnp.asarray(session_buf), cluster, **kw)
+
+    return dispatch, T_act
+
+
+def run_packed_pallas(
+    snap: PackedSnapshot,
+    weights: ScoreWeights = DEFAULT_WEIGHTS,
+    gang_rounds: int = 3,
+    block_size: int = 256,
+    interpret: bool = False,
+) -> np.ndarray:
+    """Host wrapper: PackedSnapshot → assignment[T].  Packs, makes ONE
+    fused device call (gang fixpoint included — schedule_session_pallas),
+    fetches the committed assignment.  The session ships as one byte
+    buffer; cluster planes ride the device-resident cache."""
+    dispatch, T_act = make_session_dispatch(
+        snap, weights=weights, gang_rounds=gang_rounds,
+        block_size=block_size, interpret=interpret,
+    )
+    out = np.asarray(dispatch())
     assignment = np.full(snap.n_tasks, -1, dtype=np.int32)
     n = min(snap.n_tasks, T_act)
     assignment[:n] = out[:n]
