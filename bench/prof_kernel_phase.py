@@ -1,0 +1,75 @@
+"""Decompose the in-action KERNEL phase at the headline shape:
+pack_session vs prepare/dedup vs device dispatch+fetch (warm)."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, "bench")
+sys.path.insert(0, ".")
+
+from _profsetup import TIERS, make_cache_builder  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from volcano_tpu.actions.jax_allocate import (  # noqa: E402
+    JaxAllocateAction,
+    compute_task_order,
+)
+from volcano_tpu.framework import close_session, open_session  # noqa: E402
+from volcano_tpu.ops.packing import pack_session  # noqa: E402
+
+fresh = make_cache_builder()
+action = JaxAllocateAction()
+
+cache = fresh()
+ssn = open_session(cache, TIERS, [])
+ordered = compute_task_order(ssn)
+
+jobs = {}
+for t in ordered:
+    job = ssn.jobs.get(t.job)
+    if job is not None and job.uid not in jobs:
+        jobs[job.uid] = job
+nodes = [ssn.nodes[name] for name in sorted(ssn.nodes)]
+
+for run in range(3):
+    t0 = time.perf_counter()
+    snap = pack_session(
+        ordered, list(jobs.values()), nodes,
+        enforce_pod_count="predicates" in ssn.predicate_fns,
+    )
+    pack_s = time.perf_counter() - t0
+
+    from volcano_tpu.ops.pallas_session import make_session_dispatch
+
+    t0 = time.perf_counter()
+    dispatch, T_act = make_session_dispatch(snap)
+    mk_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out = np.asarray(dispatch())
+    dev_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    proposals = {}
+    for i, task in enumerate(ordered):
+        if out[i] >= 0 and not snap.task_has_preferences[i]:
+            proposals[task.uid] = nodes[out[i]].name
+    prop_s = time.perf_counter() - t0
+    print(f"run{run}: pack={pack_s:.3f}s make_dispatch={mk_s:.3f}s "
+          f"device+fetch={dev_s:.3f}s proposals={prop_s:.3f}s")
+
+import cProfile  # noqa: E402
+import pstats  # noqa: E402
+
+pr = cProfile.Profile()
+pr.enable()
+snap = pack_session(
+    ordered, list(jobs.values()), nodes,
+    enforce_pod_count="predicates" in ssn.predicate_fns,
+)
+pr.disable()
+pstats.Stats(pr).sort_stats("cumulative").print_stats(20)
+close_session(ssn)

@@ -78,3 +78,24 @@ def test_auto_dispatch_small_custom_weights_uses_plain():
     snap = generate_snapshot(n_tasks=100, n_nodes=20, gang_size=4, seed=7)
     assert select_executor(snap, w) == "xla-scan"
     assert (run_packed_auto(snap, weights=w) == run_packed(snap, weights=w)).all()
+
+
+def test_make_session_dispatch_prestaged_matches_wrapper():
+    # the bench's compute probe (make_session_dispatch prestage=True)
+    # must enqueue the SAME kernel as run_packed_pallas — prestaging only
+    # moves the transfer, never the math
+    from volcano_tpu.ops.pallas_session import make_session_dispatch
+
+    snap = generate_snapshot(n_tasks=300, n_nodes=150, gang_size=4, seed=3)
+    want = run_packed_pallas(snap, block_size=128, interpret=True)
+
+    dispatch, T_act = make_session_dispatch(
+        snap, block_size=128, interpret=True, prestage=True)
+    out = np.asarray(dispatch())
+    got = np.full(snap.n_tasks, -1, dtype=np.int32)
+    n = min(snap.n_tasks, T_act)
+    got[:n] = out[:n]
+    assert (want == got).all()
+    # repeated dispatches (the pipelined-slope probe) stay identical
+    out2 = np.asarray(dispatch())
+    assert (np.asarray(out) == np.asarray(out2)).all()

@@ -597,3 +597,38 @@ def test_drf_preempt_wire_roundtrip():
     ev_b, pipe_b = preempt_dense(back)
     np.testing.assert_array_equal(ev_a, ev_b)
     np.testing.assert_array_equal(pipe_a, pipe_b)
+
+
+def test_make_preempt_dispatch_prestaged_matches_wrapper():
+    # bench compute probe path: prestaged dispatch ≡ run_preempt_pallas
+    import numpy as np
+
+    from volcano_tpu.ops.preempt_pallas import (
+        make_preempt_dispatch,
+        run_preempt_pallas,
+    )
+    from volcano_tpu.ops.synthetic import generate_preempt_packed
+
+    pk = generate_preempt_packed(n_victims=300, n_nodes=64, n_preemptors=64)
+    want_ev, want_pipe = run_preempt_pallas(pk, interpret=True)
+
+    made = make_preempt_dispatch(pk, interpret=True, prestage=True)
+    assert made is not None
+    dispatch, dims, vic_slot = made
+    out = np.asarray(dispatch())
+    out2 = np.asarray(dispatch())
+    assert (out == out2).all()
+
+    # unpack exactly like run_preempt_pallas
+    from volcano_tpu.ops.preempt_pallas import LANES
+
+    K, NS = dims["K"], dims["NS"]
+    ev_planes = out[: K * NS].reshape(K, NS, LANES)
+    pipe_flat = out[K * NS:].reshape(-1)
+    V, P = pk.n_victims, pk.base.n_tasks
+    sub = pk.vic_node[:V] // LANES
+    lane = pk.vic_node[:V] % LANES
+    got_ev = ev_planes[vic_slot[:V], sub, lane] > 0
+    got_pipe = pipe_flat[:P].astype(np.int32)
+    assert (want_ev == got_ev).all()
+    assert (want_pipe == got_pipe).all()
