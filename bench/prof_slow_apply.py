@@ -1,0 +1,45 @@
+"""Current cost of the GENERIC (non-bulk) apply path: drive the real
+statement/heap/event machinery with kernel proposals but fast_apply
+disabled, at a mid shape (20k x 2k), and cProfile the loop."""
+
+from __future__ import annotations
+
+import cProfile
+import pstats
+import sys
+import time
+
+sys.path.insert(0, "bench")
+sys.path.insert(0, ".")
+
+from _profsetup import TIERS, make_cache_builder  # noqa: E402
+
+from volcano_tpu.actions import jax_allocate as ja  # noqa: E402
+from volcano_tpu.framework import close_session, open_session  # noqa: E402
+
+fresh = make_cache_builder(n_tasks=20_000, n_nodes=2_000)
+action = ja.JaxAllocateAction()
+
+# disable the bulk path so execute() runs the real loop
+import volcano_tpu.actions.fast_apply as fa  # noqa: E402
+
+fa_orig = fa.try_fast_apply
+fa.try_fast_apply = lambda *a, **k: False
+
+for run in range(2):
+    cache = fresh()
+    ssn = open_session(cache, TIERS, [])
+    t0 = time.perf_counter()
+    if run == 1:
+        pr = cProfile.Profile()
+        pr.enable()
+    action.execute(ssn)
+    if run == 1:
+        pr.disable()
+    t = time.perf_counter() - t0
+    n = len(cache.binder.binds)
+    print(f"run{run}: execute={t:.3f}s binds={n} -> {t/max(n,1)*1e6:.1f}us/task")
+    close_session(ssn)
+
+pstats.Stats(pr).sort_stats("tottime").print_stats(22)
+fa.try_fast_apply = fa_orig

@@ -264,3 +264,41 @@ def test_fast_apply_refuses_unknown_plugin():
     finally:
         del ssn.plugins["mystery"]
         close_session(ssn)
+
+
+def test_ready_counter_invariant_through_fast_apply():
+    """job.ready_num (the O(1) counter behind ready_task_num) must equal
+    the recomputed bucket sum after the bulk path's direct status-index
+    surgery, session- and cache-side."""
+    from volcano_tpu.api.job_info import _READY_STATUSES
+
+    def recount(job):
+        return sum(
+            len(tasks)
+            for status, tasks in job.task_status_index.items()
+            if status in _READY_STATUSES
+        )
+
+    cluster = _cluster()
+    cache, ssn, engaged = _run(cluster, force_slow=False)
+    assert engaged
+    for job in list(ssn.jobs.values()) + list(cache.jobs.values()):
+        assert job.ready_task_num() == recount(job), job.uid
+        assert job.ready_task_num() > 0  # the session placed everything
+    close_session(ssn)
+
+
+def test_ready_counter_immune_to_double_add():
+    """A watch-echo double add (cache._add_task racing its own bind echo)
+    must not inflate ready_num: the bucket write is idempotent, so the
+    counter has to be as well."""
+    from volcano_tpu.api import JobInfo, Resource, TaskInfo, TaskStatus
+
+    job = JobInfo("j1")
+    t = TaskInfo(uid="t1", job="j1", name="t1", namespace="ns",
+                 resreq=Resource(), status=TaskStatus.Running)
+    job.add_task_info(t)
+    job.add_task_info(t)  # echo
+    assert job.ready_task_num() == 1
+    job.delete_task_info(t)
+    assert job.ready_task_num() == 0

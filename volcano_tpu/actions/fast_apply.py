@@ -32,6 +32,7 @@ from __future__ import annotations
 from typing import Dict, List
 
 from volcano_tpu.api import TaskInfo, TaskStatus
+from volcano_tpu.api.job_info import _READY_STATUSES
 from volcano_tpu.framework.session import Session
 
 #: plugins whose event handlers / state this bulk path models exactly
@@ -282,13 +283,17 @@ def try_fast_apply(
         jtasks = job.tasks
         pending = job.task_status_index.get(TaskStatus.Pending)
         bbucket = job.task_status_index.setdefault(binding, {})
+        ready_gain = 0
         for t in tasks:
             jtasks.pop(t.uid, None)
             jtasks[t.uid] = t
             if pending is not None:
                 pending.pop(t.uid, None)
+            if t.status not in _READY_STATUSES:
+                ready_gain += 1  # Pending → Binding enters the ready set
             t.status = binding
             bbucket[t.uid] = t
+        job.ready_num += ready_gain
         if pending is not None and not pending:
             del job.task_status_index[TaskStatus.Pending]
 

@@ -174,6 +174,11 @@ class JobInfo:
 
         self.tasks: Dict[str, TaskInfo] = {}
         self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
+        #: count of tasks in _READY_STATUSES, maintained by _index/_unindex
+        #: — ready_task_num() is on the per-comparison hot path (PQ job
+        #: order, gang readiness) and the bucket-sum recompute was ~4% of
+        #: the whole generic apply loop
+        self.ready_num: int = 0
 
         self.allocated: Resource = empty_resource()
         self.total_request: Resource = empty_resource()
@@ -186,7 +191,13 @@ class JobInfo:
     # ---- task bookkeeping ----
 
     def _index(self, task: TaskInfo) -> None:
-        self.task_status_index.setdefault(task.status, {})[task.uid] = task
+        bucket = self.task_status_index.setdefault(task.status, {})
+        # the dict write is idempotent under a watch-echo double add
+        # (cache._add_task races its own bind echo) — the counter must
+        # be too, so only count a uid actually entering the bucket
+        if task.uid not in bucket and task.status in _READY_STATUSES:
+            self.ready_num += 1
+        bucket[task.uid] = task
 
     def _unindex(self, task: TaskInfo) -> None:
         bucket = self.task_status_index.get(task.status)
@@ -194,6 +205,8 @@ class JobInfo:
             del bucket[task.uid]
             if not bucket:
                 del self.task_status_index[task.status]
+            if task.status in _READY_STATUSES:
+                self.ready_num -= 1
 
     def add_task_info(self, task: TaskInfo) -> None:
         self.tasks[task.uid] = task
@@ -231,11 +244,7 @@ class JobInfo:
     # ---- readiness (job_info.go:346-398) ----
 
     def ready_task_num(self) -> int:
-        return sum(
-            len(tasks)
-            for status, tasks in self.task_status_index.items()
-            if status in _READY_STATUSES
-        )
+        return self.ready_num
 
     def waiting_task_num(self) -> int:
         return len(self.task_status_index.get(TaskStatus.Pipelined, {}))
@@ -274,6 +283,7 @@ class JobInfo:
         info.creation_timestamp = self.creation_timestamp
         info.allocated = self.allocated.clone()
         info.total_request = self.total_request.clone()
+        info.ready_num = self.ready_num
         tasks = info.tasks
         index = info.task_status_index
         for uid, t in self.tasks.items():
