@@ -28,6 +28,7 @@ class SchedulerDaemon(BaseDaemon):
         scheduler_conf: str = "",
         schedule_period: float = 1.0,
         scheduler_name: str = "volcano-tpu",
+        gc_quiesce_period: int = 0,
         **daemon_kw,
     ):
         super().__init__(api, period=schedule_period, **daemon_kw)
@@ -35,7 +36,8 @@ class SchedulerDaemon(BaseDaemon):
             client=SchedulerClient(api), scheduler_name=scheduler_name
         )
         self.scheduler = Scheduler(
-            self.cache, scheduler_conf_path=scheduler_conf, period=schedule_period
+            self.cache, scheduler_conf_path=scheduler_conf,
+            period=schedule_period, gc_quiesce_period=gc_quiesce_period,
         )
 
     def _on_start(self) -> None:
@@ -62,6 +64,12 @@ def main(argv=None) -> int:
     parser.add_argument("--scheduler-conf", default="")
     parser.add_argument("--schedule-period", type=float, default=1.0)
     parser.add_argument("--scheduler-name", default="volcano-tpu")
+    parser.add_argument(
+        "--gc-quiesce-period", type=int, default=0,
+        help="every N cycles, gc-collect and freeze survivors so "
+        "sessions stop re-traversing the long-lived cache graph "
+        "(0 = off)",
+    )
     add_common_args(parser)
     args = parser.parse_args(argv)
 
@@ -71,6 +79,7 @@ def main(argv=None) -> int:
             scheduler_conf=args.scheduler_conf,
             schedule_period=args.schedule_period,
             scheduler_name=args.scheduler_name,
+            gc_quiesce_period=args.gc_quiesce_period,
             listen_host=args.listen_host,
             listen_port=args.listen_port,
             leader_elect=args.leader_elect,
