@@ -31,22 +31,17 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "ben
 
 
 def _gc_quiesce() -> None:
-    """Collect, then freeze survivors into the permanent generation.
-
-    Each config leaves megabytes of live long-lived state (cluster
-    objects, jit caches, device handles); without freezing, every gen-2
+    """Thaw-collect-freeze (volcano_tpu.utils.gcutil — shared with the
+    scheduler daemon's --gc-quiesce-period).  Each config leaves
+    megabytes of live long-lived state; without freezing, every gen-2
     collection inside the NEXT timed region re-traverses all of it, and
     the measured action latency grows with how many configs ran before
     it (observed 2.1s standalone → 6.5s after four configs at the 50k
-    shape).  The real daemon has the same discipline available; the
-    bench applies it so numbers reflect the framework, not the
-    harness's accumulated garbage.  Unfreeze first: a previous quiesce's
-    frozen objects that have since died (last iteration's cluster graph)
-    would otherwise be unreclaimable forever — thaw, collect the dead,
-    re-freeze the survivors."""
-    gc.unfreeze()
-    gc.collect()
-    gc.freeze()
+    shape).  The bench applies it so numbers reflect the framework, not
+    the harness's accumulated garbage."""
+    from volcano_tpu.utils.gcutil import gc_quiesce
+
+    gc_quiesce()
 
 
 def _time(fn, warmup: int = 1, iters: int = 3) -> float:
@@ -100,7 +95,7 @@ def _relay_probe(in_bytes: int = 0, out_elems: int = 1024):
     return probe
 
 
-def _pipelined_compute_s(dispatch, k: int = 8, iters: int = 3) -> "float | None":
+def _pipelined_compute_s(dispatch, k: int = 16, iters: int = 3) -> "float | None":
     """Pure device-compute estimate for one kernel dispatch (None when
     jitter swamps even the pipelined estimate).
 
@@ -161,7 +156,8 @@ def bench_config(name: str, kwargs: dict, iters: int = 5) -> dict:
     # ms-scale sessions need more samples: at ~2ms/session a single
     # scheduler tick of background load swings the 5-iter median 2-4x
     # (observed 0.5x-2.8x across runs of the 1k config)
-    if snap.n_tasks * snap.n_nodes <= 1_000_000:
+    area = snap.n_tasks * snap.n_nodes
+    if area <= 1_000_000:
         iters = max(iters, 25)
 
     # Session input volume = what the executor actually ships per
@@ -240,7 +236,10 @@ def bench_config(name: str, kwargs: dict, iters: int = 5) -> dict:
     # Native baseline — best of 1-thread and 16-thread (the pooled sweep
     # only wins on some shapes; the reference would use whichever is
     # faster).  Single measured run for the big configs.
-    base_iters = 1 if snap.n_tasks * snap.n_nodes > 5_000_000 else iters
+    # single-sample baselines swing 2x with load (config 3's baseline
+    # read 186ms and 361ms in adjacent runs); only the really big shapes
+    # (multi-second baselines) stay at one sample
+    base_iters = iters if area <= 5_000_000 else (3 if area <= 50_000_000 else 1)
     try:
         if interleaved_baseline_s is not None:
             baseline_s = interleaved_baseline_s

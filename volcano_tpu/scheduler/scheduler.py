@@ -88,19 +88,17 @@ class Scheduler:
                 )
         finally:
             close_session(ssn)
+            # in a finally so persistently-failing cycles (BaseDaemon
+            # retries them) still thaw+collect previously frozen dead
+            # objects instead of pinning them for the failure window
+            if self.gc_quiesce_period > 0:
+                self._cycles_since_quiesce += 1
+                if self._cycles_since_quiesce >= self.gc_quiesce_period:
+                    self._cycles_since_quiesce = 0
+                    from volcano_tpu.utils.gcutil import gc_quiesce
+
+                    gc_quiesce()
         metrics.update_e2e_duration(time.perf_counter() - start)
-
-        if self.gc_quiesce_period > 0:
-            self._cycles_since_quiesce += 1
-            if self._cycles_since_quiesce >= self.gc_quiesce_period:
-                self._cycles_since_quiesce = 0
-                import gc
-
-                # thaw first so objects frozen last quiesce that have
-                # since died are reclaimed, then freeze the survivors
-                gc.unfreeze()
-                gc.collect()
-                gc.freeze()
 
     def run(self, cycles: Optional[int] = None) -> None:
         """scheduler.go:63-69 — wait.Until(runOnce, period)."""

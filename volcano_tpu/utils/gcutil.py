@@ -1,0 +1,20 @@
+"""GC quiesce: thaw, collect, freeze.
+
+Long-lived cluster state (a 50k-pod cache graph is millions of objects)
+makes every gen-2 collection inside a hot region re-traverse it all;
+freezing survivors into the permanent generation removes them from the
+collector's working set.  Thaw first so objects frozen by a PREVIOUS
+quiesce that have since died in a cycle are reclaimed — delayed by one
+quiesce interval, never leaked.  Used by the scheduler loop
+(--gc-quiesce-period) and by bench.py between configs.
+"""
+
+from __future__ import annotations
+
+import gc
+
+
+def gc_quiesce() -> None:
+    gc.unfreeze()
+    gc.collect()
+    gc.freeze()
