@@ -12,6 +12,7 @@ which a naive ceil would inflate to 14m).
 
 from __future__ import annotations
 
+import functools
 import math
 from fractions import Fraction
 
@@ -57,16 +58,23 @@ def _parse_exact(value) -> Fraction:
     return Fraction(s)
 
 
+# Quantity inputs are immutable scalars (str/int/float) drawn from a
+# small vocabulary in practice ("250m", "1Gi", ... repeated across every
+# pod of a template), and Fraction arithmetic is the single hottest part
+# of feeding 50k pods into the cache — cache the exact results.
+@functools.lru_cache(maxsize=4096)
 def parse_quantity(value) -> float:
     """Parse a k8s quantity to a float base value."""
     return float(_parse_exact(value))
 
 
+@functools.lru_cache(maxsize=4096)
 def milli_value(value) -> float:
     """Quantity → milli units, rounded up (resource.Quantity.MilliValue)."""
     return float(math.ceil(_parse_exact(value) * 1000))
 
 
+@functools.lru_cache(maxsize=4096)
 def int_value(value) -> float:
     """Quantity → integer base value, rounded up (resource.Quantity.Value)."""
     return float(math.ceil(_parse_exact(value)))

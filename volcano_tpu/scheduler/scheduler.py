@@ -88,6 +88,10 @@ class Scheduler:
                 )
         finally:
             close_session(ssn)
+            # stamp e2e BEFORE the quiesce: the collection pause is
+            # maintenance, not scheduling latency — folding it in would
+            # spike the p99 every Nth cycle
+            elapsed = time.perf_counter() - start
             # in a finally so persistently-failing cycles (BaseDaemon
             # retries them) still thaw+collect previously frozen dead
             # objects instead of pinning them for the failure window
@@ -98,7 +102,7 @@ class Scheduler:
                     from volcano_tpu.utils.gcutil import gc_quiesce
 
                     gc_quiesce()
-        metrics.update_e2e_duration(time.perf_counter() - start)
+        metrics.update_e2e_duration(elapsed)
 
     def run(self, cycles: Optional[int] = None) -> None:
         """scheduler.go:63-69 — wait.Until(runOnce, period)."""
