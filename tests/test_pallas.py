@@ -99,3 +99,15 @@ def test_make_session_dispatch_prestaged_matches_wrapper():
     # repeated dispatches (the pipelined-slope probe) stay identical
     out2 = np.asarray(dispatch())
     assert (np.asarray(out) == np.asarray(out2)).all()
+
+
+def test_warmup_kernels_runs_auto_path():
+    # the --warmup flag on vtpu-scheduler / vtpu-compute-plane: compiles
+    # whatever executor auto-dispatch selects for the bucket, and returns
+    # its name
+    from volcano_tpu.ops.dispatch import select_executor, warmup_kernels
+    from volcano_tpu.ops.synthetic import generate_snapshot
+
+    executor = warmup_kernels(n_tasks=256, n_nodes=64, gang_size=4)
+    snap = generate_snapshot(n_tasks=256, n_nodes=64, gang_size=4)
+    assert executor == select_executor(snap)

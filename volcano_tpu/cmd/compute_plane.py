@@ -32,12 +32,9 @@ def main(argv=None) -> int:
     if args.warmup:
         # populate the jit cache so the first real session doesn't pay
         # compile latency (~20-40s on TPU)
-        from volcano_tpu.ops.dispatch import run_packed_auto
-        from volcano_tpu.ops.synthetic import generate_snapshot
+        from volcano_tpu.ops.dispatch import warmup_kernels
 
-        t0 = time.time()
-        run_packed_auto(generate_snapshot(n_tasks=4096, n_nodes=1024, gang_size=8))
-        log.info("warmup compile done in %.1fs", time.time() - t0)
+        warmup_kernels()  # times and logs itself
 
     server = ComputePlaneServer(args.socket).start()
     try:

@@ -70,8 +70,19 @@ def main(argv=None) -> int:
         "sessions stop re-traversing the long-lived cache graph "
         "(0 = off)",
     )
+    parser.add_argument(
+        "--warmup", action="store_true",
+        help="compile the headline-bucket session kernels before the "
+        "first cycle (first compile is ~20-40s on TPU; same flag as "
+        "vtpu-compute-plane)",
+    )
     add_common_args(parser)
     args = parser.parse_args(argv)
+
+    if args.warmup:
+        from volcano_tpu.ops.dispatch import warmup_kernels
+
+        warmup_kernels()  # times and logs itself
 
     return serve_forever(
         SchedulerDaemon(

@@ -232,3 +232,29 @@ def run_packed_auto(
 
         return run_packed_blocked(snap, weights=weights, gang_rounds=gang_rounds)
     return run_packed(snap, weights=weights, gang_rounds=gang_rounds)
+
+
+def warmup_kernels(n_tasks: int = 4096, n_nodes: int = 1024,
+                   gang_size: int = 8) -> str:
+    """Populate the jit cache for the session kernels at a
+    representative shape bucket (first TPU compile is ~20-40s; every
+    same-bucket session after is cache-hit) and log the duration.
+    Returns the executor auto-dispatch SELECTED — if the run degraded to
+    a fallback mid-warmup, the dispatcher logged that error itself.
+    Shared by the compute-plane sidecar's and the scheduler daemon's
+    ``--warmup`` flags."""
+    import time
+
+    from volcano_tpu.ops.synthetic import generate_snapshot
+    from volcano_tpu.utils.logging import get_logger
+
+    snap = generate_snapshot(
+        n_tasks=n_tasks, n_nodes=n_nodes, gang_size=gang_size
+    )
+    executor = select_executor(snap)
+    t0 = time.monotonic()
+    run_packed_auto(snap)
+    get_logger(__name__).info(
+        "warmup compile (%s) done in %.1fs", executor, time.monotonic() - t0
+    )
+    return executor
