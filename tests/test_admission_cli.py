@@ -356,3 +356,80 @@ class TestValidateTaskTemplate:
             core.Container(name="metrics", ports=[core.ContainerPort(container_port=8080)]),
         ]
         validate_job(job)
+
+
+class TestValidateTemplateIdentity:
+    """The round-5 validator widening: env names, volume mounts, pod
+    volumes, hostname/subdomain (k8s ValidatePodSpec subset)."""
+
+    def test_bad_env_name_denied(self):
+        job = _job_with_template(
+            core.Container(env=[core.EnvVar(name="1BAD", value="x")])
+        )
+        with pytest.raises(AdmissionError, match="environment variable name"):
+            validate_job(job)
+
+    def test_duplicate_env_name_allowed(self):
+        # k8s validation.ValidateEnv admits duplicates (last entry wins
+        # at runtime); the subset must not deny what the reference admits
+        job = _job_with_template(
+            core.Container(env=[core.EnvVar(name="A", value="1"),
+                                core.EnvVar(name="A", value="2")])
+        )
+        validate_job(job)
+
+    def test_mount_without_declared_volume_denied(self):
+        job = _job_with_template(
+            core.Container(volume_mounts=[
+                core.VolumeMount(name="data", mount_path="/data")])
+        )
+        with pytest.raises(AdmissionError, match="not declared in spec.volumes"):
+            validate_job(job)
+
+    def test_mount_with_declared_volume_allowed(self):
+        job = _job_with_template(
+            core.Container(volume_mounts=[
+                core.VolumeMount(name="data", mount_path="/data")])
+        )
+        job.spec.tasks[0].template.spec.volumes = [
+            core.Volume(name="data", source={"emptyDir": {}})
+        ]
+        validate_job(job)
+
+    def test_duplicate_mount_path_denied(self):
+        job = _job_with_template(
+            core.Container(volume_mounts=[
+                core.VolumeMount(name="data", mount_path="/data"),
+                core.VolumeMount(name="data2", mount_path="/data"),
+            ])
+        )
+        job.spec.tasks[0].template.spec.volumes = [
+            core.Volume(name="data", source={"emptyDir": {}}),
+            core.Volume(name="data2", source={"emptyDir": {}}),
+        ]
+        with pytest.raises(AdmissionError, match="duplicate mount path"):
+            validate_job(job)
+
+    def test_duplicate_pod_volume_denied(self):
+        job = _job_with_template()
+        job.spec.tasks[0].template.spec.volumes = [
+            core.Volume(name="v", source={"emptyDir": {}}),
+            core.Volume(name="v", source={"emptyDir": {}}),
+        ]
+        with pytest.raises(AdmissionError, match="duplicate volume name"):
+            validate_job(job)
+
+    def test_bad_hostname_denied(self):
+        job = _job_with_template()
+        job.spec.tasks[0].template.spec.hostname = "Bad_Host"
+        with pytest.raises(AdmissionError, match="hostname"):
+            validate_job(job)
+
+    def test_valid_identity_fields_allowed(self):
+        job = _job_with_template(
+            core.Container(env=[core.EnvVar(name="VC_TASK_INDEX", value="0")])
+        )
+        spec = job.spec.tasks[0].template.spec
+        spec.hostname = "worker-0"
+        spec.subdomain = "j-svc"
+        validate_job(job)

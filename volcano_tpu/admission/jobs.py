@@ -5,12 +5,14 @@ jobs/validate/admit_job.go:103-258.
 
 Validation SUBSET note: this module checks job/task naming (DNS-1123),
 replica/minAvailable arithmetic, duplicate task names, policy event/
-action legality (incl. exclusiveness rules), and resource quantity
-syntax.  The reference additionally runs the complete vendored k8s
-PodTemplateSpec validators (admit_job.go:194+ → k8s validation.
-ValidatePodTemplateSpec — full field-by-field pod spec validation);
-pod specs that slip this subset fail at pod-creation time rather than
-at admission.  Documented in README "Known gaps".
+action legality (incl. exclusiveness rules), resource quantity syntax
+and requests≤limits, restart policy, port legality, env-var names,
+volume-mount/volume cross-references, and pod volume/hostname/subdomain
+identity.  The reference runs the complete vendored k8s PodTemplateSpec
+validators (admit_job.go:194+ → k8s validation.ValidatePodTemplateSpec);
+fields outside this subset (image presence, probes, security contexts,
+lifecycle hooks) fail at pod-creation time rather than at admission.
+Documented in README "Known gaps".
 """
 
 from __future__ import annotations
@@ -73,6 +75,8 @@ def _validate_policies(policies: List[batch.LifecyclePolicy], path: str) -> List
 
 _VALID_RESTART_POLICIES = {"", "Always", "OnFailure", "Never"}
 _VALID_PROTOCOLS = {"TCP", "UDP", "SCTP"}
+#: k8s validation.IsEnvVarName
+_ENV_NAME_RE = re.compile(r"^[-._a-zA-Z][-._a-zA-Z0-9]*$")
 
 
 def _validate_task_template(task: batch.TaskSpec, index: int) -> List[str]:
@@ -90,6 +94,21 @@ def _validate_task_template(task: batch.TaskSpec, index: int) -> List[str]:
             f"{path}.spec.restartPolicy: unsupported value "
             f"{spec.restart_policy!r};"
         )
+
+    # pod-level identity: volume names unique + DNS-1123; hostname /
+    # subdomain DNS-1123 when set (k8s ValidatePodSpec)
+    volume_names = set()
+    for vi, vol in enumerate(spec.volumes or []):
+        vpath = f"{path}.spec.volumes[{vi}]"
+        if not vol.name or not is_dns1123_label(vol.name):
+            msgs.append(f"{vpath}.name: must be a valid DNS-1123 label;")
+        if vol.name in volume_names:
+            msgs.append(f"{vpath}.name: duplicate volume name {vol.name!r};")
+        volume_names.add(vol.name)
+    if spec.hostname and not is_dns1123_label(spec.hostname):
+        msgs.append(f"{path}.spec.hostname: must be a valid DNS-1123 label;")
+    if spec.subdomain and not is_dns1123_label(spec.subdomain):
+        msgs.append(f"{path}.spec.subdomain: must be a valid DNS-1123 label;")
 
     container_names = set()
     all_containers = [
@@ -135,6 +154,30 @@ def _validate_task_template(task: batch.TaskSpec, index: int) -> List[str]:
                 msgs.append(
                     f"{cpath}.resources.requests[{res}]: "
                     "must be less than or equal to the limit;"
+                )
+
+        for ei, env in enumerate(container.env or []):
+            epath = f"{cpath}.env[{ei}]"
+            # duplicates are VALID in k8s (last entry wins) — only the
+            # name syntax is checked, matching validation.ValidateEnv
+            if not env.name or not _ENV_NAME_RE.match(env.name):
+                msgs.append(f"{epath}.name: not a valid environment variable name;")
+
+        mount_paths_seen = set()
+        for mi, mount in enumerate(container.volume_mounts or []):
+            mpath = f"{cpath}.volumeMounts[{mi}]"
+            if not mount.mount_path:
+                msgs.append(f"{mpath}.mountPath: required;")
+            elif mount.mount_path in mount_paths_seen:
+                msgs.append(
+                    f"{mpath}.mountPath: duplicate mount path "
+                    f"{mount.mount_path!r};"
+                )
+            mount_paths_seen.add(mount.mount_path)
+            if mount.name not in volume_names:
+                msgs.append(
+                    f"{mpath}.name: volume {mount.name!r} not declared "
+                    "in spec.volumes;"
                 )
 
         for pi, port in enumerate(container.ports):

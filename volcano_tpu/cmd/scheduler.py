@@ -80,9 +80,22 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     if args.warmup:
-        from volcano_tpu.ops.dispatch import warmup_kernels
+        import os
 
-        warmup_kernels()  # times and logs itself
+        if os.environ.get("VTPU_COMPUTE_PLANE"):
+            # kernels run in the sidecar (which has its own --warmup);
+            # the in-process copies only serve the failure fallback —
+            # don't block startup compiling them
+            from volcano_tpu.utils.logging import get_logger
+
+            get_logger(__name__).info(
+                "skipping local warmup: VTPU_COMPUTE_PLANE is set "
+                "(warm the sidecar with its own --warmup)"
+            )
+        else:
+            from volcano_tpu.ops.dispatch import warmup_kernels
+
+            warmup_kernels()  # times and logs itself
 
     return serve_forever(
         SchedulerDaemon(
