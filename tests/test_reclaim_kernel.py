@@ -265,3 +265,35 @@ def test_both_roles_multi_job_queue_refused_and_falls_back():
     host = _run_action(mk(), ReclaimAction())
     dense = _run_action(mk(), JaxReclaimAction())
     assert dense == host
+
+
+def test_synthetic_reclaim_pressure_invariants():
+    """generate_reclaim_packed: every starved reclaimer lands by
+    reclaiming greedy victims; evictions stay within gang floors; the
+    incremental prefilter (reclaim_dense) keeps exact per-node drains."""
+    from volcano_tpu.ops.synthetic import generate_reclaim_packed
+
+    pk = generate_reclaim_packed(n_victims=900, n_nodes=100,
+                                 n_reclaimers=100)
+    evicted, pipelined = reclaim_dense(pk)
+    assert (pipelined >= 0).all()  # pressure shape: everyone reclaims in
+    # every pipelined node had at least one eviction backing it
+    ev_nodes = set(pk.vic_node[np.nonzero(evicted)[0]])
+    assert set(pipelined.tolist()) <= ev_nodes
+    # gang floors respected: no victim job evicted below min_available —
+    # the generator puts ~20% of victim jobs ONE eviction above their
+    # floor, so this bites (and the incremental gang-flip path runs)
+    ready = pk.job_ready0.copy()
+    for v in np.nonzero(evicted)[0]:
+        ready[pk.vic_job[v]] -= 1
+    vjobs = set(pk.vic_job.tolist())
+    # the gang guard's `min_available == 1` escape admits eviction below
+    # the floor for min-1 jobs (host semantics, pinned by the
+    # equivalence tests above); the floor binds only for min > 1
+    assert all(ready[j] >= pk.job_min_avail[j]
+               for j in vjobs if pk.job_min_avail[j] > 1)
+    blocked = [j for j in vjobs if pk.job_min_avail[j] > 1]
+    assert blocked, "generator produced no gang-blocked victim jobs"
+    # at least one blocked job was driven exactly TO its floor, proving
+    # the mid-pass eligibility flip engaged
+    assert any(ready[j] == pk.job_min_avail[j] for j in blocked)

