@@ -733,3 +733,34 @@ def test_scheduler_gc_quiesce_period():
     finally:
         # leave no frozen state behind for other tests
         gc.unfreeze()
+
+
+def test_job_delete_cascades_to_pods_and_podgroup():
+    """Deleting a Job must take its Pods and PodGroup with it (the k8s
+    owner-reference GC the reference relies on) and release the
+    scheduler cache's node accounting — the soak leak: before the
+    cascade, deleted jobs pinned their bound pods forever and the
+    cluster filled up."""
+    cluster = Cluster()
+    submit(cluster, name="cascade", replicas=3, min_available=3)
+    cluster.tick()
+    pods = [p for p in cluster.kube.list_pods("default")
+            if p.metadata.name.startswith("cascade-")]
+    assert pods and all(p.spec.node_name for p in pods)
+    held0 = sum(len(n.tasks) for n in cluster.cache.nodes.values())
+    assert held0 == 3
+
+    cluster.vc.delete_job("default", "cascade")
+    cluster.tick()
+
+    assert not [p for p in cluster.kube.list_pods("default")
+                if p.metadata.name.startswith("cascade-")]
+    assert all(pg.metadata.name != "cascade"
+               for pg in cluster.api.list("PodGroup", "default"))
+    assert sum(len(n.tasks) for n in cluster.cache.nodes.values()) == 0
+    # and the freed capacity is actually reusable
+    submit(cluster, name="cascade2", replicas=3, min_available=3)
+    cluster.tick()
+    pods2 = [p for p in cluster.kube.list_pods("default")
+             if p.metadata.name.startswith("cascade2-")]
+    assert pods2 and all(p.spec.node_name for p in pods2)

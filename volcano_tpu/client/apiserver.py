@@ -54,6 +54,12 @@ class APIServer:
         self._watchers: Dict[str, List[WatchHandler]] = {}
         self._admission: Dict[Tuple[str, str], List[AdmissionHook]] = {}
         self._rv = 0
+        #: reverse owner index for cascade deletion (the k8s garbage
+        #: collector the reference relies on for Job → Pod/PodGroup/
+        #: ConfigMap cleanup): (owner kind, ns, owner name) → set of
+        #: (child kind, child key).  Entries are validated lazily at
+        #: cascade time, so staleness is harmless.
+        self._owned: Dict[Tuple[str, str, str], set] = {}
 
     # ---- helpers ----
 
@@ -94,6 +100,13 @@ class APIServer:
 
     # ---- CRUD ----
 
+    def _register_owners(self, obj, key: str) -> None:
+        for ref in obj.metadata.owner_references:
+            if not ref.controller:
+                continue
+            parent = (ref.kind, obj.metadata.namespace, ref.name)
+            self._owned.setdefault(parent, set()).add((obj.kind, key))
+
     def create(self, obj):
         with self._lock:
             kind = obj.kind
@@ -105,6 +118,7 @@ class APIServer:
             self._bump(obj)
             stored = obj.clone()
             bucket[key] = stored
+            self._register_owners(stored, key)
             self._notify(kind, ADDED, None, stored.clone())
             return obj
 
@@ -133,6 +147,7 @@ class APIServer:
             self._bump(obj)
             stored = obj.clone()
             bucket[key] = stored
+            self._register_owners(stored, key)
             self._notify(kind, MODIFIED, old.clone(), stored.clone())
             return obj
 
@@ -152,6 +167,7 @@ class APIServer:
             self._bump(obj)
             stored = obj.clone()
             bucket[key] = stored
+            self._register_owners(stored, key)
             self._notify(kind, MODIFIED, old.clone(), stored.clone())
             return obj
 
@@ -175,5 +191,27 @@ class APIServer:
             old = bucket.pop(key, None)
             if old is None:
                 raise NotFoundError(f"{kind} {key} not found")
-            self._notify(kind, DELETED, old.clone(), None)
+            # Owner-reference cascade — the k8s garbage collector the
+            # reference leans on: deleting a Job must take its Pods,
+            # PodGroup, and plugin resources (ConfigMaps/Secrets) with
+            # it (createJobPod sets the controller ownerReference;
+            # pkg/apis/helpers CreatedBy*).  Children are popped
+            # transitively under the same lock; DELETED notifications
+            # fire parent-first so controller caches unwind top-down.
+            deleted = [(kind, old)]
+            frontier = [old]
+            while frontier:
+                owner = frontier.pop()
+                parent = (
+                    owner.kind,
+                    owner.metadata.namespace,
+                    owner.metadata.name,
+                )
+                for ckind, ckey in self._owned.pop(parent, ()):  # noqa: B020
+                    child = self._store.get(ckind, {}).pop(ckey, None)
+                    if child is not None:  # stale index entries are fine
+                        deleted.append((ckind, child))
+                        frontier.append(child)
+            for dkind, dobj in deleted:
+                self._notify(dkind, DELETED, dobj.clone(), None)
             return old

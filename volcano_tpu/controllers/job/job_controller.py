@@ -324,6 +324,12 @@ class JobController:
             st = jobstate.new_state(job_info)
             action = apply_policies(job_info.job, req)
             st.execute(action)
+        except NotFoundError as e:
+            # the job was deleted while this request sat in the queue —
+            # forget the key, like syncJob's IsNotFound return-nil path
+            # (job_controller_actions.go); requeueing would only retry a
+            # tombstone until the budget runs out
+            log.debug("job %s gone before handling: %s", req.key(), e)
         except Exception as e:  # noqa: BLE001
             log.error("failed to handle job %s: %s", req.key(), e)
             # Requeue with a retry budget (AddRateLimited equivalent) so a
