@@ -1,0 +1,96 @@
+"""Trace recording overhead: Scheduler.run_once latency with the cycle
+recorder disabled vs enabled at event granularity, on the 10k-pod
+synthetic config.  Acceptance gate (ISSUE 1): enabled-at-event-
+granularity must stay under +5%.
+
+Snapshot capture is measured separately (snapshot_every=1, the worst
+case) — it's the sampled knob, not the always-on path.
+
+Emits one JSON line per mode plus a summary line with the delta, like
+the other bench/prof_*.py scripts.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, "bench")
+sys.path.insert(0, ".")
+
+from _profsetup import TIERS, make_cache_builder  # noqa: E402
+
+from volcano_tpu import trace  # noqa: E402
+from volcano_tpu.conf import SchedulerConf  # noqa: E402
+from volcano_tpu.scheduler.scheduler import Scheduler  # noqa: E402
+
+ITERS = 5
+
+fresh = make_cache_builder(n_tasks=10_000, n_nodes=1_000, gang_size=4)
+
+
+class _FixedConfScheduler(Scheduler):
+    """Pin the tier config to the profsetup tiers (no conf file I/O in
+    the measured loop)."""
+
+    def _load_conf(self):
+        conf = SchedulerConf()
+        conf.actions = ["jax-allocate"]
+        conf.tiers = TIERS
+        conf.configurations = []
+        return conf
+
+
+def cycle_ms(iters: int = ITERS) -> float:
+    """Median run_once latency over fresh caches (each cycle binds the
+    whole backlog, so the cache must be rebuilt per iteration)."""
+    samples = []
+    for _ in range(iters):
+        cache = fresh()
+        sched = _FixedConfScheduler(cache)
+        t0 = time.perf_counter()
+        sched.run_once()
+        samples.append((time.perf_counter() - t0) * 1e3)
+    samples.sort()
+    return samples[len(samples) // 2]
+
+
+# warm the jit caches so compile time doesn't pollute either mode
+cycle_ms(iters=1)
+
+trace.disable()
+disabled_ms = cycle_ms()
+print(json.dumps({"metric": "trace_cycle_latency", "mode": "disabled",
+                  "value": round(disabled_ms, 3), "unit": "ms"}))
+
+journal_dir = tempfile.mkdtemp(prefix="vtpu-trace-bench-")
+try:
+    trace.enable(journal_dir, snapshot_every=0)
+    enabled_ms = cycle_ms()
+    print(json.dumps({"metric": "trace_cycle_latency", "mode": "events",
+                      "value": round(enabled_ms, 3), "unit": "ms"}))
+
+    trace.enable(journal_dir, snapshot_every=1)
+    snapshot_ms = cycle_ms()
+    print(json.dumps({"metric": "trace_cycle_latency", "mode": "events+snapshot",
+                      "value": round(snapshot_ms, 3), "unit": "ms"}))
+finally:
+    trace.disable()
+    shutil.rmtree(journal_dir, ignore_errors=True)
+
+overhead_pct = (enabled_ms - disabled_ms) / disabled_ms * 100.0
+print(json.dumps({
+    "metric": "trace_overhead",
+    "value": round(overhead_pct, 2),
+    "unit": "%",
+    "disabled_ms": round(disabled_ms, 3),
+    "events_ms": round(enabled_ms, 3),
+    "events_snapshot_ms": round(snapshot_ms, 3),
+    "budget_pct": 5.0,
+    "within_budget": overhead_pct < 5.0,
+    "tasks": 10_000,
+    "nodes": 1_000,
+}))

@@ -137,8 +137,19 @@ class TestDualInformerWire:
                     break
                 time.sleep(0.05)
             assert kube.get_pod("ns", "raw-pod").spec.node_name == "n0"
-            # status wrote back to the RAW kind (not silently dropped)
+            # status wrote back to the RAW kind (not silently dropped).
+            # The bind is API-visible mid-cycle but the status writeback
+            # lands at close_session, a few ms later — poll rather than
+            # racing that window, on a FRESH deadline (the bind wait
+            # above may have consumed the first one).
+            deadline = time.monotonic() + 10
             stored = api.get("PodGroupV1alpha1", "ns", "raw-pg")
+            while (
+                stored.status.phase == scheduling.POD_GROUP_PENDING
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+                stored = api.get("PodGroupV1alpha1", "ns", "raw-pg")
             assert stored.status.phase in (
                 scheduling.POD_GROUP_INQUEUE, scheduling.POD_GROUP_RUNNING
             )

@@ -198,6 +198,11 @@ class AllocateAction(Action):
         return "allocate"
 
     def execute(self, ssn: Session) -> None:
+        if ssn._trace.enabled:
+            ssn._trace.event(
+                "allocate:start", "action",
+                jobs=len(ssn.jobs), nodes=len(ssn.nodes),
+            )
         drive_allocate_loop(
             ssn,
             begin_job=lambda job: ssn.statement(),

@@ -320,6 +320,11 @@ def try_fast_apply(
         # (needs_host_validation would be set), so nothing to maintain.
 
     cache.bind_batch([(t, t.node_name) for t in ordered])
+    # journal only after the batch landed — "bind" means an actual
+    # cache bind, and bind_batch mutates nothing when it raises
+    if ssn._trace.enabled:
+        for t in ordered:
+            ssn._trace.decision("bind", t.uid, t.node_name)
     return True
 
 

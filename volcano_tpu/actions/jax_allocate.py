@@ -149,6 +149,25 @@ class JaxAllocateAction(Action):
         )
         metrics.update_kernel_duration("execute", time.perf_counter() - t0)
 
+        rec = ssn._trace
+        if rec.enabled and rec.should_capture():
+            # sampled journal capture: the packed session + the kernel's
+            # assignment + the kernel parameters, the replayable tuple
+            # trace.replay.verify diffs.  The label is the executor that
+            # actually produced the assignment (including mid-session
+            # degradations; 'auto' when the compute-plane sidecar ran
+            # it), translated to replay vocabulary.
+            from volcano_tpu.ops.executor import last_allocate_executor
+            from volcano_tpu.trace.replay import replay_executor_name
+
+            rec.capture(
+                snap,
+                assignment,
+                executor=replay_executor_name(last_allocate_executor()),
+                weights=self.weights,
+                gang_rounds=self.gang_rounds,
+            )
+
         proposals = {}
         for i, task in enumerate(ordered_tasks):
             if assignment[i] >= 0 and not snap.task_has_preferences[i]:
@@ -158,7 +177,8 @@ class JaxAllocateAction(Action):
     # ---- phase 3 ----
 
     def execute(self, ssn: Session) -> None:
-        ordered = compute_task_order(ssn)
+        with ssn._trace.span("jax-allocate:order", "action"):
+            ordered = compute_task_order(ssn)
         if not ordered:
             return
         proposals, snap = self._kernel_proposals(ssn, ordered)

@@ -24,6 +24,8 @@ class PreemptAction(Action):
 
     def execute(self, ssn: Session) -> None:
         """preempt.go:45-177."""
+        if ssn._trace.enabled:
+            ssn._trace.event("preempt:start", "action", jobs=len(ssn.jobs))
         preemptors_map: Dict[str, PriorityQueue] = {}
         preemptor_tasks: Dict[str, PriorityQueue] = {}
         under_request: List = []

@@ -22,6 +22,8 @@ class ReclaimAction(Action):
 
     def execute(self, ssn: Session) -> None:
         """reclaim.go:42-202."""
+        if ssn._trace.enabled:
+            ssn._trace.event("reclaim:start", "action", jobs=len(ssn.jobs))
         queues = PriorityQueue(ssn.queue_order_fn)
         queue_map: Dict[str, object] = {}
         preemptors_map: Dict[str, PriorityQueue] = {}

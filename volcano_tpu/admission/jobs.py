@@ -100,11 +100,15 @@ def _validate_task_template(task: batch.TaskSpec, index: int) -> List[str]:
     volume_names = set()
     for vi, vol in enumerate(spec.volumes or []):
         vpath = f"{path}.spec.volumes[{vi}]"
+        # invalid names are flagged once and kept OUT of volume_names:
+        # they can't satisfy a mount reference, and two unnamed volumes
+        # are not "duplicates" of each other
         if not vol.name or not is_dns1123_label(vol.name):
             msgs.append(f"{vpath}.name: must be a valid DNS-1123 label;")
-        if vol.name in volume_names:
+        elif vol.name in volume_names:
             msgs.append(f"{vpath}.name: duplicate volume name {vol.name!r};")
-        volume_names.add(vol.name)
+        else:
+            volume_names.add(vol.name)
     if spec.hostname and not is_dns1123_label(spec.hostname):
         msgs.append(f"{path}.spec.hostname: must be a valid DNS-1123 label;")
     if spec.subdomain and not is_dns1123_label(spec.subdomain):

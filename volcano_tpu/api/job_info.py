@@ -145,6 +145,11 @@ def new_task_info(pod: core.Pod) -> TaskInfo:
     init_resreq = resreq.clone()
     for c in pod.spec.init_containers:
         init_resreq.set_max(Resource.from_resource_list(c.resources.get("requests") or {}))
+    # freeze: both objects are shared across every clone of this task
+    # (see TaskInfo.clone), so an in-place mutation anywhere would skew
+    # all of them — the guard makes that fail loudly under __debug__
+    resreq.freeze()
+    init_resreq.freeze()
     return TaskInfo(
         uid=pod.metadata.uid or f"{pod.metadata.namespace}/{pod.metadata.name}",
         job=get_job_id(pod),

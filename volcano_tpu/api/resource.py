@@ -30,9 +30,16 @@ class Resource:
     ``max_task_num`` mirrors the reference's MaxTaskNum: carried for the
     pod-count predicate only, never part of arithmetic
     (resource_info.go:37-39).
+
+    ``readonly`` is the freeze guard for shared-aliased instances
+    (TaskInfo resreq/init_resreq are shared across clones, job_info.py):
+    once :meth:`freeze` is called, the in-place mutators raise under
+    ``__debug__`` so a violation of the documented immutability invariant
+    fails loudly instead of silently skewing every snapshot sharing the
+    object.  ``clone()`` always yields a mutable copy.
     """
 
-    __slots__ = ("milli_cpu", "memory", "scalars", "max_task_num")
+    __slots__ = ("milli_cpu", "memory", "scalars", "max_task_num", "readonly")
 
     def __init__(
         self,
@@ -45,6 +52,18 @@ class Resource:
         self.memory = float(memory)
         self.scalars: Dict[str, float] = dict(scalars) if scalars else {}
         self.max_task_num = max_task_num
+        self.readonly = False
+
+    def freeze(self) -> "Resource":
+        """Mark shared-immutable; chainable."""
+        self.readonly = True
+        return self
+
+    def _raise_frozen(self) -> None:
+        raise AssertionError(
+            "in-place mutation of a frozen (shared-aliased) Resource; "
+            "clone() first or REPLACE the owning attribute"
+        )
 
     # ---- constructors ----
 
@@ -75,6 +94,7 @@ class Resource:
         r.memory = self.memory
         r.scalars = dict(self.scalars)
         r.max_task_num = self.max_task_num
+        r.readonly = False  # a copy is always mutable
         return r
 
     # ---- predicates ----
@@ -97,6 +117,8 @@ class Resource:
     # ---- arithmetic (mutating, chainable — mirrors the Go API) ----
 
     def add(self, rr: "Resource") -> "Resource":
+        if __debug__ and self.readonly:
+            self._raise_frozen()
         self.milli_cpu += rr.milli_cpu
         self.memory += rr.memory
         for name, v in rr.scalars.items():
@@ -123,6 +145,8 @@ class Resource:
         (pkg/scheduler/util/assert); accounting paths (FutureIdle, node
         remove) rely on that leniency, so they use this variant.
         """
+        if __debug__ and self.readonly:
+            self._raise_frozen()
         self.milli_cpu -= rr.milli_cpu
         self.memory -= rr.memory
         for name, v in rr.scalars.items():
@@ -130,6 +154,8 @@ class Resource:
         return self
 
     def multi(self, ratio: float) -> "Resource":
+        if __debug__ and self.readonly:
+            self._raise_frozen()
         self.milli_cpu *= ratio
         self.memory *= ratio
         for name in self.scalars:
@@ -138,6 +164,8 @@ class Resource:
 
     def set_max(self, rr: "Resource") -> "Resource":
         """Elementwise max in place (resource_info.go:162-187)."""
+        if __debug__ and self.readonly:
+            self._raise_frozen()
         self.milli_cpu = max(self.milli_cpu, rr.milli_cpu)
         self.memory = max(self.memory, rr.memory)
         for name, v in rr.scalars.items():
@@ -147,6 +175,8 @@ class Resource:
     def fit_delta(self, rr: "Resource") -> "Resource":
         """Available minus requested, with tolerance margins; negative lanes
         mark insufficient resources (resource_info.go:193-213)."""
+        if __debug__ and self.readonly:
+            self._raise_frozen()
         if rr.milli_cpu > 0:
             self.milli_cpu -= rr.milli_cpu + MIN_MILLI_CPU
         if rr.memory > 0:
@@ -223,6 +253,8 @@ class Resource:
         return self.scalars.get(name, 0.0)
 
     def set_scalar(self, name: str, value: float) -> None:
+        if __debug__ and self.readonly:
+            self._raise_frozen()
         self.scalars[name] = value
 
     def resource_names(self) -> Iterable[str]:

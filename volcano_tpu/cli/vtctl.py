@@ -8,6 +8,12 @@ Commands run against an APIServer instance: in-process when embedded
 (tests, single-process deployments) or a served endpoint when the control
 plane runs separately.  suspend/resume emit Command CRs consumed by the
 job controller (pkg/cli/job/suspend.go, resume.go).
+
+The TPU build adds ``vtctl trace record|replay|diff|export`` over the
+cycle journal (volcano_tpu/trace): record synthetic cycles to a journal
+directory, deterministically replay a captured cycle through any
+executor and diff bindings, and export a cycle's timeline as Chrome
+trace JSON.
 """
 
 from __future__ import annotations
@@ -261,6 +267,104 @@ def _queue_delete(vc: VolcanoClient, args, out) -> int:
     return 0
 
 
+# ---- trace subcommands (volcano_tpu/trace) ----
+
+def _trace_record(vc: VolcanoClient, args, out) -> int:
+    """Record synthetic scheduling cycles into a journal: per cycle, the
+    event timeline plus (sampled) the packed session + kernel assignment
+    that trace replay re-executes."""
+    import time as _time
+
+    from volcano_tpu import trace as _trace
+    from volcano_tpu.ops.kernels import DEFAULT_WEIGHTS
+    from volcano_tpu.ops.synthetic import generate_snapshot
+    from volcano_tpu.trace.replay import run_snapshot
+
+    rec = _trace.TraceRecorder(
+        journal=_trace.Journal(args.dir, keep=args.keep),
+        snapshot_every=args.snapshot_every,
+    )
+    # install globally so the dispatch/executor-layer instrumentation
+    # (dispatch:allocate naming the executor auto picked, degradation
+    # and remote-fallback events) lands in the journal too
+    prev = _trace.get_recorder()
+    _trace.set_recorder(rec)
+    try:
+        for i in range(args.cycles):
+            snap = generate_snapshot(
+                n_tasks=args.tasks,
+                n_nodes=args.nodes,
+                gang_size=args.gang_size,
+                seed=args.seed + i,
+            )
+            # the journal cycle id, NOT i — the recorder resumes after a
+            # non-empty journal's newest cycle
+            cid = rec.begin_cycle()
+            t0 = _time.perf_counter()
+            with rec.span("kernel:execute", "kernel", executor=args.executor):
+                assignment = run_snapshot(snap, executor=args.executor)
+            rec.capture(
+                snap, assignment, executor=args.executor,
+                weights=DEFAULT_WEIGHTS, gang_rounds=3,
+            )
+            placed = int((assignment[: snap.n_tasks] >= 0).sum())
+            rec.event("cycle-summary", "scheduler", placed=placed)
+            rec.end_cycle(duration_s=_time.perf_counter() - t0)
+            print(
+                f"cycle {cid}: {placed}/{snap.n_tasks} placed"
+                + (
+                    " [snapshot]"
+                    if cid in rec.journal.snapshot_cycles()
+                    else ""
+                ),
+                file=out,
+            )
+    finally:
+        _trace.set_recorder(prev)
+    print(
+        f"recorded {args.cycles} cycle(s) to {args.dir} "
+        f"(snapshots every {args.snapshot_every or 'never'})",
+        file=out,
+    )
+    return 0
+
+
+def _trace_replay(vc: VolcanoClient, args, out) -> int:
+    from volcano_tpu.trace.replay import verify
+
+    result = verify(args.dir, cycle=args.cycle, executor=args.executor)
+    print(result.summary(), file=out)
+    return 0 if result.match else 1
+
+
+def _trace_diff(vc: VolcanoClient, args, out) -> int:
+    """Replay and print the per-task binding diff (empty when identical)."""
+    from volcano_tpu.trace.replay import verify
+
+    result = verify(args.dir, cycle=args.cycle, executor=args.executor)
+    print(result.summary(), file=out)
+    for task_idx, rec_node, rep_node in result.diffs[: args.limit]:
+        print(
+            f"  task[{task_idx}]: recorded node {rec_node} != "
+            f"replayed node {rep_node}",
+            file=out,
+        )
+    if len(result.diffs) > args.limit:
+        print(f"  ... {len(result.diffs) - args.limit} more", file=out)
+    return 0 if result.match else 1
+
+
+def _trace_export(vc: VolcanoClient, args, out) -> int:
+    from volcano_tpu.trace.export import export_chrome_trace
+
+    text = export_chrome_trace(args.dir, cycle=args.cycle, path=args.out or None)
+    if args.out:
+        print(f"wrote Chrome trace to {args.out}", file=out)
+    else:
+        print(text, file=out)
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="vtctl", description="volcano-tpu control CLI")
     sub = parser.add_subparsers(dest="group", required=True)
@@ -303,6 +407,43 @@ def build_parser() -> argparse.ArgumentParser:
     qd = queue.add_parser("delete")
     qd.add_argument("--name", "-N", required=True)
 
+    trace_p = sub.add_parser(
+        "trace", description="cycle journal: record, replay, diff, export"
+    ).add_subparsers(dest="cmd", required=True)
+
+    tr = trace_p.add_parser("record", description="record synthetic cycles")
+    tr.add_argument("--dir", "-d", required=True, help="journal directory")
+    tr.add_argument("--tasks", type=int, default=1024)
+    tr.add_argument("--nodes", type=int, default=256)
+    tr.add_argument("--gang-size", dest="gang_size", type=int, default=8)
+    tr.add_argument("--cycles", type=int, default=1)
+    tr.add_argument("--seed", type=int, default=0)
+    tr.add_argument(
+        "--snapshot-every", dest="snapshot_every", type=int, default=1,
+        help="capture a replayable snapshot every Nth cycle (0 = never)",
+    )
+    tr.add_argument("--keep", type=int, default=64, help="journal ring size")
+    tr.add_argument(
+        "--executor", default="jax",
+        choices=["native", "jax", "blocked", "pallas", "auto"],
+    )
+
+    for name in ("replay", "diff"):
+        tp = trace_p.add_parser(name)
+        tp.add_argument("--dir", "-d", required=True)
+        tp.add_argument("--cycle", type=int, default=None)
+        tp.add_argument(
+            "--executor", default="jax",
+            choices=["native", "jax", "blocked", "pallas", "auto"],
+        )
+        if name == "diff":
+            tp.add_argument("--limit", type=int, default=20)
+
+    te = trace_p.add_parser("export")
+    te.add_argument("--dir", "-d", required=True)
+    te.add_argument("--cycle", type=int, default=None)
+    te.add_argument("--out", "-o", default="", help="output file (default stdout)")
+
     return parser
 
 
@@ -318,6 +459,10 @@ _HANDLERS = {
     ("queue", "list"): _queue_list,
     ("queue", "operate"): _queue_operate,
     ("queue", "delete"): _queue_delete,
+    ("trace", "record"): _trace_record,
+    ("trace", "replay"): _trace_replay,
+    ("trace", "diff"): _trace_diff,
+    ("trace", "export"): _trace_export,
 }
 
 
@@ -333,6 +478,16 @@ def main(argv: Optional[List[str]] = None, api: Optional[APIServer] = None, out=
     except (ApiError, ValueError, OSError) as e:
         print(f"error: {e}", file=out)
         return 1
+    except RuntimeError as e:
+        # only for trace commands: RuntimeError there means a
+        # supported-but-unavailable executor (replay --executor native
+        # without the C++ toolchain, pallas off-TPU) — a user error,
+        # not a crash.  Elsewhere it's a genuine internal error whose
+        # traceback must surface.
+        if args.group == "trace":
+            print(f"error: {e}", file=out)
+            return 1
+        raise
 
 
 if __name__ == "__main__":

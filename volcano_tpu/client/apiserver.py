@@ -107,6 +107,35 @@ class APIServer:
             parent = (ref.kind, obj.metadata.namespace, ref.name)
             self._owned.setdefault(parent, set()).add((obj.kind, key))
 
+    def _unregister_owners(self, obj, key: str) -> None:
+        """Prune the reverse index when a child is deleted or its owner
+        refs change on update — without this the index grows unbounded
+        and keys re-created under a dead owner's name inherit its doom."""
+        for ref in obj.metadata.owner_references:
+            if not ref.controller:
+                continue
+            parent = (ref.kind, obj.metadata.namespace, ref.name)
+            members = self._owned.get(parent)
+            if members is not None:
+                members.discard((obj.kind, key))
+                if not members:
+                    del self._owned[parent]
+
+    @staticmethod
+    def _controlled_by(child, owner) -> bool:
+        """Does ``child`` carry a controller ownerReference matching
+        ``owner``?  The k8s GC matches owners by UID; fall back to
+        kind+name when either side predates UID assignment."""
+        for ref in child.metadata.owner_references:
+            if not ref.controller:
+                continue
+            if ref.kind != owner.kind or ref.name != owner.metadata.name:
+                continue
+            if ref.uid and owner.metadata.uid:
+                return ref.uid == owner.metadata.uid
+            return True
+        return False
+
     def create(self, obj):
         with self._lock:
             kind = obj.kind
@@ -147,6 +176,7 @@ class APIServer:
             self._bump(obj)
             stored = obj.clone()
             bucket[key] = stored
+            self._unregister_owners(old, key)
             self._register_owners(stored, key)
             self._notify(kind, MODIFIED, old.clone(), stored.clone())
             return obj
@@ -167,6 +197,7 @@ class APIServer:
             self._bump(obj)
             stored = obj.clone()
             bucket[key] = stored
+            self._unregister_owners(old, key)
             self._register_owners(stored, key)
             self._notify(kind, MODIFIED, old.clone(), stored.clone())
             return obj
@@ -191,6 +222,7 @@ class APIServer:
             old = bucket.pop(key, None)
             if old is None:
                 raise NotFoundError(f"{kind} {key} not found")
+            self._unregister_owners(old, key)
             # Owner-reference cascade — the k8s garbage collector the
             # reference leans on: deleting a Job must take its Pods,
             # PodGroup, and plugin resources (ConfigMaps/Secrets) with
@@ -198,6 +230,11 @@ class APIServer:
             # pkg/apis/helpers CreatedBy*).  Children are popped
             # transitively under the same lock; DELETED notifications
             # fire parent-first so controller caches unwind top-down.
+            # A stale index entry — the child was deleted directly and a
+            # NEW object re-created under the same (kind, key) — must NOT
+            # cascade: like the k8s GC, ownership is re-verified against
+            # the child's CURRENT controller ownerReference (by UID when
+            # both sides carry one, else kind+name).
             deleted = [(kind, old)]
             frontier = [old]
             while frontier:
@@ -207,11 +244,24 @@ class APIServer:
                     owner.metadata.namespace,
                     owner.metadata.name,
                 )
+                survivors = set()
                 for ckind, ckey in self._owned.pop(parent, ()):  # noqa: B020
-                    child = self._store.get(ckind, {}).pop(ckey, None)
-                    if child is not None:  # stale index entries are fine
-                        deleted.append((ckind, child))
-                        frontier.append(child)
+                    cbucket = self._store.get(ckind, {})
+                    child = cbucket.get(ckey)
+                    if child is None:
+                        continue  # stale index entry — drop
+                    if not self._controlled_by(child, owner):
+                        # same owner key but a different controller (the
+                        # owner name was re-created with a new uid) —
+                        # keep the entry for that owner's own cascade
+                        survivors.add((ckind, ckey))
+                        continue
+                    del cbucket[ckey]
+                    self._unregister_owners(child, ckey)
+                    deleted.append((ckind, child))
+                    frontier.append(child)
+                if survivors:
+                    self._owned[parent] = survivors
             for dkind, dobj in deleted:
                 self._notify(dkind, DELETED, dobj.clone(), None)
             return old

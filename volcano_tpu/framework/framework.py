@@ -8,6 +8,7 @@ from __future__ import annotations
 import time
 from typing import List
 
+from volcano_tpu import trace
 from volcano_tpu.apis import scheduling
 from volcano_tpu.cache.interface import Cache
 from volcano_tpu.conf import Configuration, Tier
@@ -25,6 +26,8 @@ def open_session(
     cache: Cache, tiers: List[Tier], configurations: List[Configuration]
 ) -> Session:
     """framework.go:30-53 + session.go openSession:72-139."""
+    rec = trace.get_recorder()
+    open_start = time.perf_counter()
     ssn = Session(cache)
     ssn.tiers = tiers
     ssn.configurations = configurations
@@ -55,7 +58,12 @@ def open_session(
     for plugin in ssn.plugins.values():
         start = time.perf_counter()
         plugin.on_session_open(ssn)
-        metrics.update_plugin_duration(plugin.name(), time.perf_counter() - start)
+        plugin_s = time.perf_counter() - start
+        metrics.update_plugin_duration(plugin.name(), plugin_s)
+        if rec.enabled:
+            rec.complete(
+                f"plugin:{plugin.name()}.open", "plugin", start, plugin_s
+            )
 
     for job in list(ssn.jobs.values()):
         vr = ssn.job_valid(job)
@@ -74,6 +82,16 @@ def open_session(
                 )
             del ssn.jobs[job.uid]
 
+    if rec.enabled:
+        rec.complete(
+            "open_session",
+            "framework",
+            open_start,
+            time.perf_counter() - open_start,
+            jobs=len(ssn.jobs),
+            nodes=len(ssn.nodes),
+            queues=len(ssn.queues),
+        )
     log.debug(
         "Open session %s with %d jobs and %d queues",
         ssn.uid,
@@ -85,12 +103,24 @@ def open_session(
 
 def close_session(ssn: Session) -> None:
     """framework.go:56-66 + session.go closeSession:141-155."""
+    rec = trace.get_recorder()
+    close_start = time.perf_counter()
     for plugin in ssn.plugins.values():
         start = time.perf_counter()
         plugin.on_session_close(ssn)
-        metrics.update_plugin_duration(plugin.name(), time.perf_counter() - start)
+        plugin_s = time.perf_counter() - start
+        metrics.update_plugin_duration(plugin.name(), plugin_s)
+        if rec.enabled:
+            rec.complete(
+                f"plugin:{plugin.name()}.close", "plugin", start, plugin_s
+            )
 
     JobUpdater(ssn).update_all()
+    if rec.enabled:
+        rec.complete(
+            "close_session", "framework", close_start,
+            time.perf_counter() - close_start,
+        )
 
     ssn.jobs = {}
     ssn.nodes = {}

@@ -16,6 +16,7 @@ from __future__ import annotations
 import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
+from volcano_tpu import trace
 from volcano_tpu.api import (
     JobInfo,
     NodeInfo,
@@ -49,6 +50,11 @@ class Session:
     def __init__(self, cache: Cache):
         self.uid: str = str(uuid.uuid4())
         self.cache = cache
+        #: trace recorder pinned at open — the decision audit trail
+        #: (bind/pipeline/evict tuples) for this cycle.  NullRecorder
+        #: when tracing is off, so the emit guards cost one attribute
+        #: access per placement.
+        self._trace = trace.get_recorder()
 
         self.pod_group_status: Dict[str, scheduling.PodGroupStatus] = {}
 
@@ -383,6 +389,8 @@ class Session:
         if node is None:
             raise KeyError(f"failed to find node {hostname}")
         node.add_task(task)
+        if self._trace.enabled:
+            self._trace.decision("pipeline", task.uid, hostname)
         self._fire_allocate(task)
 
     def allocate(self, task: TaskInfo, hostname: str) -> None:
@@ -398,6 +406,10 @@ class Session:
         if node is None:
             raise KeyError(f"failed to find node {hostname}")
         node.add_task(task)
+        if self._trace.enabled:
+            # session-level placement; the cache bind (if the job turns
+            # ready) is journaled as "bind" by dispatch below
+            self._trace.decision("allocate", task.uid, hostname)
         self._fire_allocate(task)
 
         if self.job_ready(job):
@@ -425,6 +437,10 @@ class Session:
             self.cache.resync_task(task)
             return
         self.cache.bind(task, task.node_name)
+        if self._trace.enabled:
+            # one "bind" decision per actual cache.bind, same as the
+            # Statement commit and fast-apply paths
+            self._trace.decision("bind", task.uid, task.node_name)
         job = self.jobs.get(task.job)
         if job is None:
             raise KeyError(f"failed to find job {task.job} when dispatching")
@@ -433,6 +449,10 @@ class Session:
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         """session.go Evict — immediate cache eviction + Releasing status."""
         self.cache.evict(reclaimee, reason)
+        if self._trace.enabled:
+            self._trace.decision(
+                "evict", reclaimee.uid, reclaimee.node_name, reason
+            )
         job = self.jobs.get(reclaimee.job)
         if job is None:
             raise KeyError(f"failed to find job {reclaimee.job} when evicting")

@@ -42,6 +42,11 @@ class Statement:
         except Exception as e:  # noqa: BLE001 — bind/evict failures resync later
             log.error("Failed to evict task %s/%s: %s", reclaimee.namespace, reclaimee.name, e)
             self._unevict(reclaimee)
+            return
+        if self.ssn._trace.enabled:
+            self.ssn._trace.decision(
+                "evict", reclaimee.uid, reclaimee.node_name, reason
+            )
 
     def _unevict(self, reclaimee: TaskInfo) -> None:
         job = self.ssn.jobs.get(reclaimee.job)
@@ -103,6 +108,8 @@ class Statement:
             self.ssn.cache.resync_task(task)
             return
         self.ssn.cache.bind(task, task.node_name)
+        if self.ssn._trace.enabled:
+            self.ssn._trace.decision("bind", task.uid, task.node_name)
         job = self.ssn.jobs.get(task.job)
         if job is not None:
             job.update_task_status(task, TaskStatus.Binding)
@@ -134,5 +141,8 @@ class Statement:
                 self._commit_evict(*args)
             elif name == "allocate":
                 self._commit_allocate(*args)
-            # pipeline has no cache-side commit (statement.go:158-159)
+            # pipeline has no cache-side commit (statement.go:158-159),
+            # but a committed pipeline IS a decision — journal it
+            elif name == "pipeline" and self.ssn._trace.enabled:
+                self.ssn._trace.decision("pipeline", args[0].uid, args[1])
         self.operations.clear()

@@ -152,7 +152,19 @@ def register_job_retries(job_name: str) -> None:
 # ---- TPU-build additions: per-kernel phase timings ----
 
 def update_kernel_duration(phase: str, seconds: float) -> None:
-    """phase ∈ {compile, transfer, execute} for the device session kernel."""
+    """phase ∈ {pack, compile, transfer, execute} for the device session
+    kernel.  The same timing feeds the trace recorder's timeline when a
+    cycle is being recorded (volcano_tpu/trace) — one measurement, two
+    sinks."""
     registry.histogram(
         f"{_NAMESPACE}_tpu_kernel_latency_milliseconds", {"phase": phase}
     ).observe(seconds * 1e3)
+    from volcano_tpu import trace
+
+    rec = trace.get_recorder()
+    if rec.enabled:
+        import time
+
+        rec.complete(
+            f"kernel:{phase}", "kernel", time.perf_counter() - seconds, seconds
+        )

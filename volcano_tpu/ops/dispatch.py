@@ -148,9 +148,18 @@ def run_preempt_auto(pk, weights: ScoreWeights = DEFAULT_WEIGHTS):
     when eligible, degrading to the dense formulation on runtime
     failure.  The single copy of the preempt dispatch — used in-process,
     by the jax-preempt action, and by the compute-plane sidecar."""
+    from volcano_tpu import trace
     from volcano_tpu.ops.preempt_pack import preempt_dense
 
-    if select_preempt_executor(pk) == "pallas":
+    executor = select_preempt_executor(pk)
+    rec = trace.get_recorder()
+    if rec.enabled:
+        rec.event(
+            "dispatch:preempt", "kernel",
+            executor=executor,
+            tasks=pk.base.n_tasks, victims=pk.n_victims,
+        )
+    if executor == "pallas":
         from volcano_tpu.ops.preempt_pallas import run_preempt_pallas
 
         try:
@@ -164,6 +173,24 @@ def run_preempt_auto(pk, weights: ScoreWeights = DEFAULT_WEIGHTS):
     return preempt_dense(pk, weights=weights)
 
 
+#: executor run_packed_auto last actually EXECUTED — unlike the
+#: select_executor pick, this reflects mid-session degradations
+#: (native→xla-scan, pallas/sharded→blocked).  Single-threaded cycle
+#: loop state: read it right after the call, same thread (the trace
+#: capture in jax_allocate does).
+_last_executor = ""
+
+
+def last_executor() -> str:
+    return _last_executor
+
+
+def _note(executor: str) -> str:
+    global _last_executor
+    _last_executor = executor
+    return executor
+
+
 def run_packed_auto(
     snap: PackedSnapshot,
     weights: ScoreWeights = DEFAULT_WEIGHTS,
@@ -175,6 +202,15 @@ def run_packed_auto(
     decision tree — so what runs always matches what callers (e.g.
     bench.py's ``executor`` field) report."""
     executor = select_executor(snap, weights)
+    from volcano_tpu import trace
+
+    rec = trace.get_recorder()
+    if rec.enabled:
+        rec.event(
+            "dispatch:allocate", "kernel",
+            executor=executor, tasks=snap.n_tasks, nodes=snap.n_nodes,
+        )
+    _note(executor)
     if executor == "native":
         from volcano_tpu import native
 
@@ -183,6 +219,7 @@ def run_packed_auto(
         except RuntimeError:
             # Native executor hit an internal error mid-session — degrade
             # to the exact XLA scan rather than failing the session.
+            _note("xla-scan")
             return run_packed(snap, weights=weights, gang_rounds=gang_rounds)
     if executor == "pallas":
         from volcano_tpu.ops.blocked import run_packed_blocked
@@ -200,6 +237,7 @@ def run_packed_auto(
             get_logger(__name__).error(
                 "pallas allocate failed (%s); blocked fallback", e
             )
+            _note("blocked")
             return run_packed_blocked(
                 snap, weights=weights, gang_rounds=gang_rounds
             )
@@ -224,6 +262,7 @@ def run_packed_auto(
             get_logger(__name__).error(
                 "sharded allocate failed (%s); blocked fallback", e
             )
+            _note("blocked")
             return run_packed_blocked(
                 snap, weights=weights, gang_rounds=gang_rounds
             )

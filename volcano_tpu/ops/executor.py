@@ -68,16 +68,41 @@ def _get_remote() -> Optional[_Remote]:
     return _remote
 
 
+#: did the last execute_allocate run in-process or on the sidecar?
+_last_route = "local"
+
+
+def last_allocate_executor() -> str:
+    """Name of what the most recent execute_allocate actually ran —
+    deliberately NOT called last_executor, so it can't be confused with
+    ops/dispatch.last_executor (local dispatch vocabulary, blind to the
+    sidecar route).  'auto' when the assignment came from the sidecar —
+    its dispatch picks there against ITS hardware, so the local pick
+    would be a guess; 'auto' tells replay to re-dispatch.  Otherwise the
+    local dispatcher's record, which includes mid-session degradations.
+    Same-thread read right after the call, like the dispatch state it
+    wraps."""
+    if _last_route == "remote":
+        return "auto"
+    from volcano_tpu.ops.dispatch import last_executor as _dispatch_last
+
+    return _dispatch_last()
+
+
 def execute_allocate(snap, weights=None, gang_rounds: int = 3) -> np.ndarray:
     """PackedSnapshot → assignment, via sidecar when configured."""
     from volcano_tpu.ops.dispatch import run_packed_auto
     from volcano_tpu.ops.kernels import DEFAULT_WEIGHTS
 
+    from volcano_tpu import trace
+
+    rec = trace.get_recorder()
     weights = weights or DEFAULT_WEIGHTS
     remote = _get_remote()
     # the wire protocol carries neither weights nor gang_rounds — only
     # default-configured sessions may route remotely, or the sidecar
     # would silently run different parameters than the fallback
+    global _last_route
     if (
         remote is not None
         and weights == DEFAULT_WEIGHTS
@@ -85,27 +110,36 @@ def execute_allocate(snap, weights=None, gang_rounds: int = 3) -> np.ndarray:
         and remote.usable()
     ):
         try:
-            return remote.client.allocate(snap)
+            with rec.span("executor:remote-allocate", "kernel"):
+                out = remote.client.allocate(snap)
+            _last_route = "remote"
+            return out
         except Exception as e:  # noqa: BLE001 — degrade to in-process
             remote.healthy = False
             remote.last_probe = time.monotonic()
+            rec.event("executor:remote-fallback", "kernel", error=str(e))
             log.error(
                 "compute plane allocate failed (%s); in-process fallback", e
             )
+    _last_route = "local"
     return run_packed_auto(snap, weights=weights, gang_rounds=gang_rounds)
 
 
 def execute_preempt(pk) -> Tuple[np.ndarray, np.ndarray]:
     """PreemptPacked → (evicted, pipelined), via sidecar when configured."""
+    from volcano_tpu import trace
     from volcano_tpu.ops.dispatch import run_preempt_auto
 
+    rec = trace.get_recorder()
     remote = _get_remote()
     if remote is not None and remote.usable():
         try:
-            return remote.client.preempt(pk)
+            with rec.span("executor:remote-preempt", "kernel"):
+                return remote.client.preempt(pk)
         except Exception as e:  # noqa: BLE001
             remote.healthy = False
             remote.last_probe = time.monotonic()
+            rec.event("executor:remote-fallback", "kernel", error=str(e))
             log.error(
                 "compute plane preempt failed (%s); in-process fallback", e
             )

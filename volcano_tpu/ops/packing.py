@@ -137,6 +137,84 @@ class PackedSnapshot:
         )
 
 
+# ---- journal persistence (volcano_tpu/trace) ----
+
+#: array-valued PackedSnapshot fields, in npz key order
+_SNAPSHOT_ARRAYS = (
+    "tolerance",
+    "task_resreq",
+    "task_job",
+    "task_sel_bits",
+    "task_tol_bits",
+    "node_idle",
+    "node_used",
+    "node_alloc",
+    "node_label_bits",
+    "node_taint_bits",
+    "node_ok",
+    "node_task_count",
+    "node_max_tasks",
+    "job_min_available",
+    "job_ready_count",
+    "task_has_preferences",
+)
+
+#: scalar/list fields carried in the JSON meta record
+_SNAPSHOT_META = (
+    "resource_names",
+    "n_tasks",
+    "n_nodes",
+    "n_jobs",
+    "task_uids",
+    "node_names",
+    "job_uids",
+    "needs_host_validation",
+    "memory_exact",
+)
+
+_EXTRA_PREFIX = "__extra__"
+
+
+def save_snapshot(snap: "PackedSnapshot", path: str, **extras) -> str:
+    """Persist a PackedSnapshot to a compressed npz (plus caller extras,
+    e.g. the kernel assignment and executor name the trace journal
+    records).  Everything round-trips through load_snapshot without
+    pickle — arrays verbatim, list/str/bool fields via a JSON side
+    record."""
+    import json
+
+    payload = {}
+    for name in _SNAPSHOT_ARRAYS:
+        value = getattr(snap, name)
+        if value is not None:
+            payload[name] = value
+    meta = {name: getattr(snap, name) for name in _SNAPSHOT_META}
+    payload["__meta__"] = np.array(json.dumps(meta))
+    for key, value in extras.items():
+        payload[_EXTRA_PREFIX + key] = np.asarray(value)
+    np.savez_compressed(path, **payload)
+    return path
+
+
+def load_snapshot(path: str):
+    """Inverse of save_snapshot: (PackedSnapshot, extras dict).  String
+    extras come back as 0-d unicode arrays (``str()`` them)."""
+    import json
+
+    snap = PackedSnapshot()
+    extras = {}
+    with np.load(path, allow_pickle=False) as data:
+        for key in data.files:
+            if key == "__meta__":
+                for name, value in json.loads(str(data[key])).items():
+                    setattr(snap, name, value)
+            elif key.startswith(_EXTRA_PREFIX):
+                extras[key[len(_EXTRA_PREFIX):]] = data[key]
+            else:
+                setattr(snap, key, data[key])
+    return snap, extras
+
+
 def _resource_axis(
     tasks: Sequence[TaskInfo], nodes: Sequence[NodeInfo]
 ) -> Tuple[List[str], np.ndarray]:

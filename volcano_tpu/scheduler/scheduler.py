@@ -17,6 +17,7 @@ from volcano_tpu.conf import (
     default_scheduler_conf,
     load_scheduler_conf,
 )
+from volcano_tpu import trace
 from volcano_tpu.framework import close_session, get_action, open_session
 from volcano_tpu.framework.interface import Action
 from volcano_tpu.metrics import metrics
@@ -74,34 +75,53 @@ class Scheduler:
 
     def run_once(self) -> None:
         """scheduler.go:71-87."""
+        rec = trace.get_recorder()
+        rec.begin_cycle()
         start = time.perf_counter()
-        conf = self._load_conf()
-        actions = self._resolve_actions(conf)
-
-        ssn = open_session(self.cache, conf.tiers, conf.configurations)
+        ssn = None
         try:
+            conf = self._load_conf()
+            actions = self._resolve_actions(conf)
+
+            ssn = open_session(self.cache, conf.tiers, conf.configurations)
             for action in actions:
                 action_start = time.perf_counter()
                 action.execute(ssn)
-                metrics.update_action_duration(
-                    action.name(), time.perf_counter() - action_start
-                )
+                action_s = time.perf_counter() - action_start
+                metrics.update_action_duration(action.name(), action_s)
+                if rec.enabled:
+                    rec.complete(
+                        f"action:{action.name()}", "action",
+                        action_start, action_s,
+                    )
         finally:
-            close_session(ssn)
-            # stamp e2e BEFORE the quiesce: the collection pause is
-            # maintenance, not scheduling latency — folding it in would
-            # spike the p99 every Nth cycle
-            elapsed = time.perf_counter() - start
-            # in a finally so persistently-failing cycles (BaseDaemon
-            # retries them) still thaw+collect previously frozen dead
-            # objects instead of pinning them for the failure window
-            if self.gc_quiesce_period > 0:
-                self._cycles_since_quiesce += 1
-                if self._cycles_since_quiesce >= self.gc_quiesce_period:
-                    self._cycles_since_quiesce = 0
-                    from volcano_tpu.utils.gcutil import gc_quiesce
+            try:
+                # ssn is None when open_session itself crashed (a plugin
+                # on_session_open is the likeliest site) — that cycle's
+                # spans still get journaled below
+                if ssn is not None:
+                    close_session(ssn)
+            finally:
+                # stamp e2e BEFORE the quiesce: the collection pause is
+                # maintenance, not scheduling latency — folding it in
+                # would spike the p99 every Nth cycle
+                elapsed = time.perf_counter() - start
+                # in a finally so persistently-failing cycles (BaseDaemon
+                # retries them) still thaw+collect previously frozen dead
+                # objects instead of pinning them for the failure window
+                if self.gc_quiesce_period > 0:
+                    self._cycles_since_quiesce += 1
+                    if self._cycles_since_quiesce >= self.gc_quiesce_period:
+                        self._cycles_since_quiesce = 0
+                        from volcano_tpu.utils.gcutil import gc_quiesce
 
-                    gc_quiesce()
+                        gc_quiesce()
+                # journal flush sits outside the e2e latency stamp for
+                # the same reason the gc quiesce does (maintenance I/O),
+                # but in the innermost finally: a cycle that crashes in
+                # session open, an action, OR session close is exactly
+                # the one the forensics journal must not drop
+                rec.end_cycle(duration_s=elapsed)
         metrics.update_e2e_duration(elapsed)
 
     def run(self, cycles: Optional[int] = None) -> None:

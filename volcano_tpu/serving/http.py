@@ -5,8 +5,14 @@ Reference: the scheduler binary starts a Prometheus handler on
 endpoint (pkg/apis/helpers/helpers.go:195 StartHealthz); controllers and
 admission do the same.  Here one small threaded server carries both:
 
-  GET /healthz  → 200 "ok"      (liveness)
-  GET /metrics  → Prometheus text exposition of metrics.registry
+  GET /healthz     → 200 "ok"      (liveness)
+  GET /metrics     → Prometheus text exposition of metrics.registry
+  GET /trace/last  → Chrome trace_event JSON of the last completed
+                     scheduling cycle (404 when tracing is disabled or
+                     no cycle has finished yet) — open it in
+                     chrome://tracing / Perfetto.  Forensics, so gated
+                     like /debug/stacks: loopback always, non-loopback
+                     only with debug_enabled
 
 No third-party client library — metrics._Registry.render() already
 emits the text format.
@@ -24,6 +30,20 @@ from volcano_tpu.metrics import metrics
 class _Handler(BaseHTTPRequestHandler):
     server_version = "volcano-tpu"
 
+    def _deny_unless_debug(self) -> bool:
+        """One gate for every forensics endpoint (/debug/stacks,
+        /trace/last): answer an empty 404 and return True unless the
+        client is loopback or debug serving is explicitly enabled."""
+        if debug_allowed(
+            getattr(self.server, "debug_enabled", False),
+            self.client_address[0],
+        ):
+            return False
+        self.send_response(404)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        return True
+
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
         if self.path == "/healthz":
             check = getattr(self.server, "health_check", None)
@@ -40,6 +60,29 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             body = self.server.registry.render().encode()
             ctype = "text/plain; version=0.0.4"
+        elif self.path == "/trace/last":
+            # scheduling forensics (task uids, node placements, evict
+            # reasons) — same sensitivity class as /debug/stacks, same
+            # gate: loopback always, non-loopback only with debug_enabled
+            if self._deny_unless_debug():
+                return
+            import json
+
+            from volcano_tpu import trace
+            from volcano_tpu.trace.export import chrome_trace
+
+            rec = getattr(self.server, "recorder", None) or trace.get_recorder()
+            record = rec.last_cycle()
+            if record is None:
+                body = b"no recorded cycle (is tracing enabled?)"
+                self.send_response(404)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            body = json.dumps(chrome_trace(record)).encode()
+            ctype = "application/json"
         elif self.path == "/debug/stacks":
             # the pprof-goroutine analogue (cmd/scheduler/main.go:25
             # imports net/http/pprof): live thread stacks for hang
@@ -47,13 +90,7 @@ class _Handler(BaseHTTPRequestHandler):
             # lock state), so off-loopback binds must opt in explicitly
             # via debug_enabled — a metrics port exposed cluster-wide
             # must not also expose forensics.
-            if not debug_allowed(
-                getattr(self.server, "debug_enabled", False),
-                self.client_address[0],
-            ):
-                self.send_response(404)
-                self.send_header("Content-Length", "0")
-                self.end_headers()
+            if self._deny_unless_debug():
                 return
             import sys
             import threading
@@ -99,6 +136,7 @@ class ServingServer:
         registry=None,
         health_check=None,
         debug_enabled: bool = False,
+        recorder=None,
     ):
         self._host = host
         self._port = port
@@ -108,6 +146,9 @@ class ServingServer:
         self._health_check = health_check
         #: serve /debug/stacks to non-loopback clients (off by default)
         self._debug_enabled = debug_enabled
+        #: trace recorder serving /trace/last; None = the process-global
+        #: recorder at request time (trace.get_recorder())
+        self._recorder = recorder
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -121,6 +162,7 @@ class ServingServer:
         self._httpd.registry = self._registry
         self._httpd.health_check = self._health_check
         self._httpd.debug_enabled = self._debug_enabled
+        self._httpd.recorder = self._recorder
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="vtpu-serving", daemon=True
         )

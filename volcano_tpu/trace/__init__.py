@@ -1,0 +1,86 @@
+"""volcano_tpu.trace — cycle record/replay journal.
+
+Three pieces (ISSUE: the decision-level audit trail the metrics catalog
+lacks):
+
+  * **recorder** — thread-safe span/event capture per scheduling cycle
+    (recorder.py), zero-cost when disabled.
+  * **journal**  — JSONL event log + sampled npz PackedSnapshot captures
+    in a bounded on-disk ring (journal.py).
+  * **replayer** — deterministic re-execution of a captured snapshot
+    through any executor, diffed against the recorded bindings
+    (replay.py ``verify()``), plus Chrome trace_event timeline export
+    (export.py).
+
+Usage::
+
+    from volcano_tpu import trace
+
+    trace.enable("/var/log/vtpu-trace", snapshot_every=10)
+    ...  # scheduler cycles record themselves
+    result = trace.replay.verify("/var/log/vtpu-trace", executor="jax")
+    assert result.match
+
+Instrumented code always goes through :func:`get_recorder`; with tracing
+off that returns the shared ``NullRecorder`` whose calls are no-ops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from volcano_tpu.trace import export, journal, replay  # noqa: F401
+from volcano_tpu.trace.export import chrome_trace, export_chrome_trace
+from volcano_tpu.trace.journal import Journal
+from volcano_tpu.trace.recorder import NullRecorder, TraceRecorder
+from volcano_tpu.trace.replay import ReplayResult, run_snapshot, verify
+
+_NULL = NullRecorder()
+_recorder = _NULL
+
+
+def get_recorder():
+    """The active recorder — NullRecorder unless :func:`enable` (or
+    :func:`set_recorder`) installed a live one."""
+    return _recorder
+
+
+def set_recorder(rec: Optional[TraceRecorder]) -> None:
+    global _recorder
+    _recorder = rec if rec is not None else _NULL
+
+
+def enable(
+    journal_dir: Optional[str] = None,
+    snapshot_every: int = 0,
+    keep: int = 64,
+) -> TraceRecorder:
+    """Install a live recorder.  With ``journal_dir`` set, completed
+    cycles append to the bounded on-disk ring there; ``snapshot_every=N``
+    additionally captures the packed session + kernel assignment every
+    Nth cycle for replay."""
+    jr = Journal(journal_dir, keep=keep) if journal_dir else None
+    rec = TraceRecorder(journal=jr, snapshot_every=snapshot_every)
+    set_recorder(rec)
+    return rec
+
+
+def disable() -> None:
+    set_recorder(None)
+
+
+__all__ = [
+    "Journal",
+    "NullRecorder",
+    "ReplayResult",
+    "TraceRecorder",
+    "chrome_trace",
+    "disable",
+    "enable",
+    "export_chrome_trace",
+    "get_recorder",
+    "replay",
+    "run_snapshot",
+    "set_recorder",
+    "verify",
+]

@@ -1,0 +1,88 @@
+"""Chrome ``trace_event`` export — view recorded cycles in
+chrome://tracing / Perfetto.
+
+The recorder already emits Chrome-shaped events (ph "X" complete spans
+with ts/dur in microseconds, ph "i" instants); this module wraps them in
+the JSON object format and renders decisions as instant events on a
+dedicated "decisions" track so bind/evict activity lines up with the
+spans that produced it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+#: synthetic track (tid) for decision instants, kept clear of real thread ids
+_DECISIONS_TID = 0
+
+
+def chrome_trace(record: Dict[str, Any]) -> Dict[str, Any]:
+    """One recorder cycle record → Chrome trace JSON object."""
+    pid = 1
+    events = []
+    for e in record.get("events", []):
+        ev = {
+            "name": e.get("name", ""),
+            "cat": e.get("cat", "event"),
+            "ph": e.get("ph", "i"),
+            "ts": e.get("ts", 0.0),
+            "pid": pid,
+            "tid": e.get("tid", 1),
+        }
+        if ev["ph"] == "X":
+            ev["dur"] = e.get("dur", 0.0)
+        if ev["ph"] == "i":
+            ev["s"] = "t"  # thread-scoped instant
+        if e.get("args"):
+            ev["args"] = e["args"]
+        events.append(ev)
+    ts0 = record.get("start_us", 0.0)
+    for d in record.get("decisions", []):
+        events.append(
+            {
+                "name": f"{d.get('kind', 'bind')}:{d.get('task', '')}",
+                "cat": "decision",
+                "ph": "i",
+                # pre-ts journals (no "ts" on decisions) fall back to
+                # the cycle start
+                "ts": d.get("ts", ts0),
+                "pid": pid,
+                "tid": _DECISIONS_TID,
+                "s": "t",
+                "args": {k: v for k, v in d.items() if k != "ts"},
+            }
+        )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "cycle": record.get("cycle", -1),
+            "duration_ms": record.get("duration_ms", 0.0),
+            "wall_time": record.get("wall_time", 0.0),
+            "n_decisions": len(record.get("decisions", [])),
+            # >0 means the per-cycle cap truncated the capture: the
+            # timeline below is incomplete, not a full record
+            "n_dropped": record.get("n_dropped", 0),
+        },
+    }
+
+
+def export_chrome_trace(
+    journal, cycle: Optional[int] = None, path: Optional[str] = None
+) -> str:
+    """Render a journaled cycle to a Chrome trace JSON file; returns the
+    rendered JSON string (and writes it when ``path`` is given)."""
+    from volcano_tpu.trace.journal import Journal
+
+    if isinstance(journal, str):
+        journal = Journal(journal)
+    if cycle is None:
+        cycle = journal.last_cycle()
+        if cycle is None:
+            raise FileNotFoundError(f"journal {journal.root!r} has no cycles")
+    text = json.dumps(chrome_trace(journal.read_cycle(cycle)), indent=1)
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
