@@ -370,13 +370,19 @@ def bench_action(name: str, kwargs: dict, iters: int = 3) -> dict:
     number the kernel-only configs cannot show — the whole framework's
     session latency, host machinery included (VERDICT r4 item 1).
 
-    ``value`` is the action execute() wall time (the reference's
-    action-latency metric measures the same span,
-    pkg/scheduler/metrics/metrics.go:56-63); session open (the snapshot
-    deep copy, cache.go:712-790's analogue) is reported alongside.  The
-    native baseline is the C++ 16-thread loop on the identical packed
-    session — the stand-in for the reference's in-action hot loop."""
+    ``value`` is the WARM-CYCLE action execute() wall time: one
+    persistent cache + pack cache, with binds reverted between cycles
+    through status-only churn (bench/_profsetup.revert_binds) — "the
+    cluster is unchanged modulo prior binds".  Task rows stay
+    pack-cached, node planes delta-repack, the device planes scatter
+    dirty rows, and session open reuses whatever clones the previous
+    session left untouched.  The cold numbers (fresh cache, fresh pack)
+    are reported alongside as ``action_cold_ms``/``session_open_cold_ms``
+    so the cold→warm split is visible per config.  The native baseline
+    is the C++ 16-thread loop on the identical packed session — the
+    stand-in for the reference's in-action hot loop."""
     from volcano_tpu import native
+    from volcano_tpu.actions import jax_allocate as ja_mod
     from volcano_tpu.actions.jax_allocate import JaxAllocateAction, compute_task_order
     from volcano_tpu.framework import close_session, open_session
     from volcano_tpu.ops.packing import pack_session
@@ -385,18 +391,18 @@ def bench_action(name: str, kwargs: dict, iters: int = 3) -> dict:
     # bench/prof_* scripts so their numbers line up with this metric
     # (bench/ is put on sys.path once at module import)
     from _profsetup import TIERS as tier_conf
-    from _profsetup import make_cache_builder
+    from _profsetup import capture_task_infos, make_cache_builder, revert_binds
 
     fresh_cache = make_cache_builder(**kwargs)
-
     action = JaxAllocateAction()
-    open_times, exec_times = [], []
-    binds = 0
+
+    # ---- cold: fresh cache per cycle (first iteration compiles) ----
     baseline_s = None
-    for it in range(iters + 1):  # first iteration is the compile warmup
+    cold_open = cold_exec = None
+    for it in range(2):
         cache = fresh_cache()
-        # the 50k-pod cluster graph is live for the whole action — take
-        # it out of the collector's working set before the timed region
+        # the cluster graph is live for the whole action — take it out
+        # of the collector's working set before the timed region
         _gc_quiesce()
         t0 = time.perf_counter()
         ssn = open_session(cache, tier_conf, [])
@@ -422,6 +428,25 @@ def bench_action(name: str, kwargs: dict, iters: int = 3) -> dict:
                 )
             except RuntimeError:
                 baseline_s = None
+            t1 = time.perf_counter()
+        action.execute(ssn)
+        t2 = time.perf_counter()
+        close_session(ssn)
+        if it > 0:
+            cold_open, cold_exec = t1 - t0, t2 - t1
+
+    # ---- warm: ONE persistent cache; binds reverted between cycles ----
+    cache = fresh_cache()
+    cache.snapshot_reuse = True
+    orig_tis = capture_task_infos(cache)
+    open_times, exec_times = [], []
+    phase = {}
+    warm_binds = 0
+    for it in range(iters + 1):  # iteration 0 seeds the pack cache
+        _gc_quiesce()
+        binds0 = len(cache.binder.binds)
+        t0 = time.perf_counter()
+        ssn = open_session(cache, tier_conf, [])
         t1 = time.perf_counter()
         action.execute(ssn)
         t2 = time.perf_counter()
@@ -429,9 +454,12 @@ def bench_action(name: str, kwargs: dict, iters: int = 3) -> dict:
         if it > 0:
             open_times.append(t1 - t0)
             exec_times.append(t2 - t1)
-        binds = len(cache.binder.binds)
+            phase = dict(ja_mod.last_phase_stats)
+            warm_binds = len(cache.binder.binds) - binds0
+        revert_binds(cache, orig_tis)
 
     action_s = float(np.median(exec_times))
+    rnd = lambda v: round(v, 3) if isinstance(v, float) else v
     return {
         "metric": f"action_latency_{name}",
         "value": round(action_s * 1e3, 3),
@@ -439,8 +467,16 @@ def bench_action(name: str, kwargs: dict, iters: int = 3) -> dict:
         "vs_baseline": round(baseline_s / action_s, 2) if baseline_s else None,
         "baseline_ms": round(baseline_s * 1e3, 3) if baseline_s else None,
         "session_open_ms": round(float(np.median(open_times)) * 1e3, 3),
-        "pods_per_sec": round(binds / action_s),
-        "binds": binds,
+        "action_cold_ms": round(cold_exec * 1e3, 3),
+        "session_open_cold_ms": round(cold_open * 1e3, 3),
+        "pack_delta_ms": rnd(phase.get("pack_ms")),
+        "relay_overlap_ms": rnd(phase.get("relay_overlap_ms")),
+        "order_ms": rnd(phase.get("order_ms")),
+        "pack_mode": phase.get("mode"),
+        "reused_tasks": phase.get("reused_tasks"),
+        "repacked_nodes": phase.get("repacked_nodes"),
+        "pods_per_sec": round(warm_binds / action_s) if action_s else None,
+        "binds": warm_binds,
         "tasks": kwargs["n_tasks"],
         "nodes": kwargs["n_nodes"],
     }
@@ -541,22 +577,28 @@ def main() -> int:
 
     results = []
     for name, kw in configs.items():
-        results.append(
+        r = (
             bench_preempt_config(name, {k: v for k, v in kw.items() if k != "preempt"})
             if kw.get("preempt")
             else bench_config(name, kw)
         )
         _gc_quiesce()  # this config's survivors must not tax the next one
-
-    # Full-framework action latency at the headline shape (real Session,
-    # host machinery included) — reported on stderr and folded into the
-    # headline line so BENCH consumers see both numbers.
-    if headline in configs:
-        action = bench_action(headline, BASELINE_CONFIGS[headline])
-        print(json.dumps(action), file=sys.stderr)
-        results[-1]["action_ms"] = action["value"]
-        results[-1]["action_vs_baseline"] = action["vs_baseline"]
-        results[-1]["action_session_open_ms"] = action["session_open_ms"]
+        # Full-framework WARM-CYCLE action latency for every allocate
+        # config (real Session, host machinery, persistent pack cache) —
+        # detailed line on stderr, key fields folded into the config's
+        # result so BENCH consumers track the user-visible cycle, not
+        # just the session kernel.
+        if not kw.get("preempt"):
+            action = bench_action(name, kw)
+            print(json.dumps(action), file=sys.stderr)
+            r["action_ms"] = action["value"]
+            r["action_vs_baseline"] = action["vs_baseline"]
+            r["action_session_open_ms"] = action["session_open_ms"]
+            r["action_cold_ms"] = action["action_cold_ms"]
+            r["pack_delta_ms"] = action["pack_delta_ms"]
+            r["relay_overlap_ms"] = action["relay_overlap_ms"]
+            _gc_quiesce()
+        results.append(r)
 
     for r in results[:-1]:
         print(json.dumps(r), file=sys.stderr)

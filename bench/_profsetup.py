@@ -34,6 +34,35 @@ class ListBinder:
         self.binds.append((f"{task.namespace}/{task.name}", hostname))
 
 
+def capture_task_infos(cache):
+    """uid → pristine pending TaskInfo clone, captured right after the
+    cache feed — the revert pool for warm-cycle benching."""
+    return {
+        t.uid: t.clone()
+        for job in cache.jobs.values()
+        for t in job.tasks.values()
+    }
+
+
+def revert_binds(cache, orig_tis):
+    """Return every bound task to Pending through the cache's internal
+    event mutations — exactly what a status-only update_pod pair does
+    (node accounting re-derives and is marked dirty; the task's packed
+    row stays clean because the pod SPEC never changed).  The bench's
+    stand-in for 'last cycle's pods finished and an identical batch
+    arrived', which is what makes a warm cycle measurable at full
+    session width."""
+    with cache._mutex:
+        for job in list(cache.jobs.values()):
+            for t in list(job.tasks.values()):
+                if t.node_name:
+                    orig = orig_tis.get(t.uid)
+                    if orig is None:
+                        continue
+                    cache._delete_task(t)
+                    cache._add_task(orig.clone())
+
+
 def make_cache_builder(**overrides):
     """Returns a zero-arg callable building a fresh fed cache of the
     headline shape (or the shape given by overrides)."""

@@ -1,0 +1,88 @@
+"""Cold-vs-warm pack profile: pack_session from scratch vs
+PackCache.pack after bind + status-only-revert churn (the warm-cycle
+protocol bench.py measures), with a cProfile of the warm pack.
+
+Usage: python bench/prof_pack_delta.py [n_tasks] [n_nodes]
+Defaults to a sub-headline 10k×2k shape so the profile finishes fast;
+pass 50000 10000 for the headline.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import pstats
+import sys
+import time
+
+sys.path.insert(0, "bench")
+sys.path.insert(0, ".")
+
+from _profsetup import (  # noqa: E402
+    TIERS,
+    capture_task_infos,
+    make_cache_builder,
+    revert_binds,
+)
+
+from volcano_tpu.actions.jax_allocate import (  # noqa: E402
+    JaxAllocateAction,
+    compute_task_order,
+)
+from volcano_tpu.framework import close_session, open_session  # noqa: E402
+from volcano_tpu.ops.packing import pack_session  # noqa: E402
+from volcano_tpu.utils.gcutil import gc_quiesce  # noqa: E402
+
+n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 2_000
+
+cache = make_cache_builder(n_tasks=n_tasks, n_nodes=n_nodes)()
+orig_tis = capture_task_infos(cache)
+pc = cache.pack_cache
+
+
+def session_inputs(ssn):
+    ordered = compute_task_order(ssn)
+    jobs = {}
+    for t in ordered:
+        j = ssn.jobs.get(t.job)
+        if j is not None and j.uid not in jobs:
+            jobs[j.uid] = j
+    nodes = [ssn.nodes[n] for n in sorted(ssn.nodes)]
+    return ordered, list(jobs.values()), nodes
+
+
+# ---- cycle 1: cold pack + full action (binds land) ----
+gc_quiesce()
+ssn = open_session(cache, TIERS, [])
+ordered, jobs, nodes = session_inputs(ssn)
+t0 = time.perf_counter()
+cold_snap = pack_session(ordered, jobs, nodes)
+cold_s = time.perf_counter() - t0
+t0 = time.perf_counter()
+pc.pack(ordered, jobs, nodes, ssn.pack_epoch)
+seed_s = time.perf_counter() - t0
+print(f"cold pack_session: {cold_s * 1e3:8.2f} ms")
+print(f"pack cache (cold): {seed_s * 1e3:8.2f} ms  {pc.last_stats}")
+JaxAllocateAction().execute(ssn)
+close_session(ssn)
+
+# ---- churn: binds reverted via status-only events ----
+revert_binds(cache, orig_tis)
+
+# ---- cycle 2: warm delta pack ----
+gc_quiesce()
+ssn = open_session(cache, TIERS, [])
+ordered, jobs, nodes = session_inputs(ssn)
+pr = cProfile.Profile()
+pr.enable()
+t0 = time.perf_counter()
+warm_snap = pc.pack(ordered, jobs, nodes, ssn.pack_epoch)
+warm_s = time.perf_counter() - t0
+pr.disable()
+close_session(ssn)
+
+print(f"warm delta pack:   {warm_s * 1e3:8.2f} ms  {pc.last_stats}")
+print(f"cold/warm ratio:   {cold_s / warm_s:8.1f}x")
+changed = sorted(warm_snap.delta.planes) if warm_snap.delta else "(wholesale)"
+print(f"delta planes:      {changed}")
+pstats.Stats(pr).sort_stats("cumulative").print_stats(15)

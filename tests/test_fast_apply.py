@@ -302,3 +302,92 @@ def test_ready_counter_immune_to_double_add():
     assert job.ready_task_num() == 1
     job.delete_task_info(t)
     assert job.ready_task_num() == 0
+
+
+def _residual_cluster(kind: str):
+    """Clean gang jobs plus ONE residual job (created last → processed
+    last by the drive loop's creation-timestamp order, so bulk-then-slow
+    equals the pure-slow processing order)."""
+    cluster = _cluster(n_jobs=4, gang=3)
+    if kind == "preference":
+        extra = build_pod(
+            "ns", "odd-t0", "", {"cpu": "1", "memory": "1Gi"}, group="pgodd",
+            affinity={"nodeAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {"weight": 1, "preference": {"matchExpressions": [
+                        {"key": "zone", "operator": "In", "values": ["z1"]}]}}]}},
+        )
+    else:  # pvc
+        from volcano_tpu.apis import core
+
+        extra = build_pod("ns", "odd-t0", "", {"cpu": "1", "memory": "1Gi"},
+                          group="pgodd")
+        extra.spec.volumes = [
+            core.Volume(name="v",
+                        source={"persistentVolumeClaim": {"claimName": "c"}})
+        ]
+    cluster["pods"].append(extra)
+    cluster["pod_groups"].append(build_pod_group("ns", "pgodd", 1, queue="q"))
+    if kind == "pvc":
+        from volcano_tpu.apis import core
+
+        cluster["pvcs"] = [core.PersistentVolumeClaim(
+            metadata=core.ObjectMeta(name="c", namespace="ns"),
+            spec={"storageClassName": "std"},
+            status={"phase": "Bound"},
+        )]
+    return cluster
+
+
+def _make_cache_with_pvcs(cluster):
+    pvcs = cluster.pop("pvcs", [])
+    cache = make_cache(**copy.deepcopy(cluster))
+    for pvc in pvcs:
+        cache.add_pvc(pvc)
+    cluster["pvcs"] = pvcs
+    return cache
+
+
+@pytest.mark.parametrize("kind", ["preference", "pvc"])
+def test_partial_bulk_apply_matches_slow_path(kind):
+    """One odd task (preference terms / PVC volume) no longer forces the
+    whole session onto the Statement loop: clean jobs bulk-commit, the
+    residual runs host-side, and the final session + cache state equals
+    the pure-slow path's."""
+    import volcano_tpu.actions.fast_apply as fa
+
+    cluster = _residual_cluster(kind)
+
+    # fast (partial) run, counting what the bulk path actually committed
+    cache_f = _make_cache_with_pvcs(cluster)
+    ssn_f = open_session(cache_f, STANDARD(), [])
+    batches = []
+    orig_bind_batch = cache_f.bind_batch
+    cache_f.bind_batch = lambda pairs: (batches.append(len(pairs)),
+                                        orig_bind_batch(pairs))[1]
+    engaged = {}
+    real = fa.try_fast_apply
+    fa.try_fast_apply = lambda *a, **k: engaged.setdefault("r", real(*a, **k))
+    try:
+        JaxAllocateAction().execute(ssn_f)
+    finally:
+        fa.try_fast_apply = real
+        cache_f.bind_batch = orig_bind_batch
+    assert engaged["r"] is False  # residual present → not fully applied
+    assert batches and batches[0] == 12  # the 4 clean gangs bulk-committed
+
+    # pure slow run
+    cache_s = _make_cache_with_pvcs(cluster)
+    ssn_s = open_session(cache_s, STANDARD(), [])
+    fa.try_fast_apply = lambda *a, **k: False
+    try:
+        JaxAllocateAction().execute(ssn_s)
+    finally:
+        fa.try_fast_apply = real
+
+    # everything — including the residual task — got placed identically
+    assert dict(cache_f.binder.binds) == dict(cache_s.binder.binds)
+    assert len(cache_f.binder.binds) == 13
+    _assert_state_equal((cache_f, ssn_f), (cache_s, ssn_s))
+    close_session(ssn_f)
+    close_session(ssn_s)

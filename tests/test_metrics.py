@@ -1,0 +1,151 @@
+"""Metric unit + catalog assertions (reference semantics:
+pkg/scheduler/metrics/metrics.go:38-121).
+
+The load-bearing one: ``*_latency_microseconds`` histograms must observe
+MICROSECONDS — the first four releases observed milliseconds into them,
+so every exported plugin/action/task latency was 1000× off.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from volcano_tpu.metrics import metrics
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    metrics.registry.reset()
+    yield
+    metrics.registry.reset()
+
+
+def _sum_of(rendered: str, series: str) -> float:
+    for line in rendered.splitlines():
+        if line.startswith(series + " ") or (
+            line.startswith(series) and "} " in line and line.split("{")[0] == series.split("{")[0]
+        ):
+            if line.split(" ")[0] == series or line.startswith(series):
+                return float(line.rsplit(" ", 1)[1])
+    raise AssertionError(f"{series} not rendered:\n{rendered}")
+
+
+def test_microsecond_histograms_observe_microseconds():
+    metrics.update_plugin_duration("drf", 0.002)       # 2 ms
+    metrics.update_action_duration("allocate", 0.050)  # 50 ms
+    metrics.update_task_schedule_duration(0.000090)    # 90 µs
+    out = metrics.registry.render()
+    assert (
+        'volcano_plugin_scheduling_latency_microseconds_sum{plugin="drf"} 2000.0'
+        in out
+    )
+    assert (
+        'volcano_action_scheduling_latency_microseconds_sum{action="allocate"} 50000.0'
+        in out
+    )
+    assert "volcano_task_scheduling_latency_microseconds_sum 90.0" in out
+
+
+def test_millisecond_histograms_observe_milliseconds():
+    metrics.update_e2e_duration(0.120)
+    metrics.update_job_schedule_duration(1.5)
+    out = metrics.registry.render()
+    assert "volcano_e2e_scheduling_latency_milliseconds_sum 120.0" in out
+    assert "volcano_e2e_job_scheduling_latency_milliseconds_sum 1500.0" in out
+
+
+def test_microsecond_buckets_cover_action_scale():
+    # a 100 ms action must land in a finite bucket, not only +Inf
+    metrics.update_action_duration("allocate", 0.100)
+    h = metrics.registry.histogram(
+        "volcano_action_scheduling_latency_microseconds", {"action": "allocate"}
+    )
+    assert h.buckets[-1] >= 100_000
+    assert sum(h.counts[:-1]) == 1, "observation fell into +Inf"
+
+
+def test_schedule_attempts_counter():
+    metrics.register_schedule_attempt("scheduled")
+    metrics.register_schedule_attempt("scheduled")
+    metrics.register_schedule_attempt("unschedulable")
+    out = metrics.registry.render()
+    assert 'volcano_schedule_attempts_total{result="scheduled"} 2.0' in out
+    assert 'volcano_schedule_attempts_total{result="unschedulable"} 1.0' in out
+
+
+def test_schedule_attempts_from_real_session():
+    """close_session's job updater registers one attempt per considered
+    job, bucketed by outcome."""
+    from volcano_tpu.actions.jax_allocate import JaxAllocateAction
+    from volcano_tpu.framework import close_session, open_session
+
+    from tests.builders import build_node, build_pod, build_pod_group, build_queue
+    from tests.scheduler_helpers import make_cache, tiers
+
+    cache = make_cache(
+        nodes=[build_node("n0", {"cpu": "8", "memory": "16Gi"})],
+        pods=[
+            build_pod("ns", "ok-t0", "", {"cpu": "1", "memory": "1Gi"}, group="ok"),
+            # min_available 3 with one pod: never gang-ready
+            build_pod("ns", "sad-t0", "", {"cpu": "1", "memory": "1Gi"}, group="sad"),
+        ],
+        pod_groups=[
+            build_pod_group("ns", "ok", 1, queue="q"),
+            build_pod_group("ns", "sad", 3, queue="q"),
+        ],
+        queues=[build_queue("q")],
+    )
+    ssn = open_session(
+        cache, tiers(["priority", "gang"], ["drf", "predicates", "nodeorder"]), []
+    )
+    JaxAllocateAction().execute(ssn)
+    close_session(ssn)
+    out = metrics.registry.render()
+    assert 'volcano_schedule_attempts_total{result="scheduled"} 1.0' in out
+    assert 'volcano_schedule_attempts_total{result="unschedulable"} 1.0' in out
+
+
+def test_reference_catalog_names_render():
+    """Name-for-name audit against the reference metric catalog
+    (metrics.go:38-121): every exported family renders under the
+    expected name."""
+    metrics.update_plugin_duration("drf", 0.001)
+    metrics.update_action_duration("allocate", 0.001)
+    metrics.update_e2e_duration(0.001)
+    metrics.update_job_schedule_duration(0.001)
+    metrics.update_task_schedule_duration(0.001)
+    metrics.update_pod_schedule_status("success")
+    metrics.update_preemption_victims_count(2)
+    metrics.register_preemption_attempts()
+    metrics.update_unschedule_task_count("j", 1)
+    metrics.update_unschedule_job_count(1)
+    metrics.register_job_retries("j")
+    metrics.register_schedule_attempt("scheduled")
+    metrics.update_kernel_duration("pack", 0.001)
+    out = metrics.registry.render()
+    for name in (
+        "volcano_plugin_scheduling_latency_microseconds",
+        "volcano_action_scheduling_latency_microseconds",
+        "volcano_e2e_scheduling_latency_milliseconds",
+        "volcano_e2e_job_scheduling_latency_milliseconds",
+        "volcano_task_scheduling_latency_microseconds",
+        "volcano_pod_schedule_success",
+        "volcano_total_preemption_victims",
+        "volcano_total_preemption_attempts",
+        "volcano_unschedule_task_count",
+        "volcano_unschedule_job_count",
+        "volcano_job_retry_counts",
+        "volcano_schedule_attempts_total",
+        "volcano_tpu_kernel_latency_milliseconds",
+    ):
+        assert name in out, name
+
+
+def test_job_latency_buckets_cover_minutes_scale():
+    # a 90 s job-scheduling latency must land in a finite bucket
+    metrics.update_job_schedule_duration(90.0)
+    h = metrics.registry.histogram(
+        "volcano_e2e_job_scheduling_latency_milliseconds", {}
+    )
+    assert h.buckets[-1] >= 90_000
+    assert sum(h.counts[:-1]) == 1, "observation fell into +Inf"

@@ -103,6 +103,9 @@ def drive_allocate_loop(
             pending_tasks[job.uid] = build_pending_task_queue(ssn, job)
         tasks = pending_tasks[job.uid]
 
+        # the loop body may write fit errors/deltas onto the job clone
+        # even when nothing places — conservatively touched
+        ssn.touched_jobs.add(job.uid)
         ctx = begin_job(job)
 
         while not tasks.empty():

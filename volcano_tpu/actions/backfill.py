@@ -52,6 +52,7 @@ class BackfillAction(Action):
                     break
                 if not allocated:
                     job.nodes_fit_errors[task.uid] = fe
+                    ssn.touched_jobs.add(job.uid)
 
 
 def new() -> BackfillAction:

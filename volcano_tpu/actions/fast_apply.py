@@ -20,11 +20,26 @@ ordered tasks plus per-object bulk writebacks:
     internal mutations as per-task bind() under one mutex hold, with the
     binder/event effects run in task order
 
-``try_fast_apply`` returns False (caller must run the slow loop) unless
-the session/kernel state matches the envelope above — unknown plugins,
-partial placements, PVC-backed pods, preference terms, or inexact
-packing all refuse.  tests/test_fast_apply.py pins the resulting session
-+ cache state equal to the slow path's, field by field.
+The commit is PARTIAL at job granularity: jobs whose every pending task
+carries a clean validated-exact proposal bulk-commit; jobs with a
+preference task, a PVC-backed pod, or a missing proposal stay on the
+slow Statement loop, which runs only over that residual (committed jobs
+drain to empty pending queues).  ``try_fast_apply`` returns True only
+when nothing was left for the slow loop; session-level envelope
+violations (unknown plugins, inexact packing, host-validation needs)
+still refuse wholesale with nothing committed.
+
+Equivalence scope: for fully-applied sessions, tests/test_fast_apply.py
+pins the resulting session + cache state equal to the slow path's,
+field by field.  For PARTIAL sessions the bulk subset commits before
+the residual loop runs, so when a residual job sorts BEFORE a clean job
+in the drive order AND the two contend for capacity, placements can
+differ from the pure slow path's interleaving — the same
+capacity-race envelope the kernel-proposal fallback already documents
+(jax_allocate.py): every placement is still individually valid, kernel
+resource accounting is conservative (it reserved for the residual tasks
+too), and the partial-path tests pin exact state equality for the
+residual-sorts-last case.
 """
 
 from __future__ import annotations
@@ -114,10 +129,23 @@ def try_fast_apply(
     proposals: Dict[str, str],
     snap,
 ) -> bool:
-    """Commit ``proposals`` in bulk; False when outside the envelope."""
+    """Bulk-commit the provably-clean subset of ``proposals``.
+
+    Returns True when EVERY ordered task committed (the caller can skip
+    the Statement loop entirely).  Returns False either because the
+    session is outside the bulk envelope (nothing committed) or because
+    only a subset of jobs was bulk-committed — the caller then runs the
+    slow drive loop, which naturally skips the committed jobs (their
+    pending queues are empty) and handles only the residual tasks
+    (preference terms, PVC flows, missing proposals).  One odd task no
+    longer costs a full-session Python loop.
+
+    Bulk granularity is the JOB: gang commit/discard is all-or-nothing
+    per job, and the kernel's gang fixpoint only emits proposals for
+    jobs it could fully place, so a job whose every pending task has a
+    clean validated-exact proposal commits exactly as the slow path
+    would."""
     if snap.needs_host_validation or not snap.memory_exact:
-        return False
-    if snap.task_has_preferences[: snap.n_tasks].any():
         return False
     if not set(ssn.plugins) <= _KNOWN_PLUGINS:
         return False
@@ -132,17 +160,9 @@ def try_fast_apply(
     ]
     if not set(ready_chain) <= {"gang"}:
         return False
-    # every ordered task must have a validated-exact proposal
-    if len(proposals) < len(ordered):
-        return False
     cache = ssn.cache
     if not hasattr(cache, "bind_batch"):
         return False
-    for t in ordered:
-        if t.uid not in proposals:
-            return False
-        if t.pod is not None and cache.task_claim_names(t):
-            return False  # PVC flows keep the slow path's volume logic
 
     drf = ssn.plugins.get("drf")
     proportion = ssn.plugins.get("proportion")
@@ -166,8 +186,51 @@ def try_fast_apply(
         return False
 
     nodes_by_name = ssn.nodes
+    gang_ready = bool(ready_chain)
 
-    # ---- single pass over ordered tasks ----
+    # ---- classify jobs: bulk-eligible vs residual ----
+    groups: Dict[str, List[TaskInfo]] = {}
+    has_pref = snap.task_has_preferences
+    pref_by_uid = {}
+    for i, t in enumerate(ordered):
+        groups.setdefault(t.job, []).append(t)
+        pref_by_uid[t.uid] = bool(has_pref[i]) if i < len(has_pref) else False
+    eligible: set = set()
+    for uid, tasks in groups.items():
+        job = ssn.jobs.get(uid)
+        if job is None:
+            continue
+        ok = True
+        for t in tasks:
+            host = proposals.get(t.uid)
+            if host is None or pref_by_uid[t.uid]:
+                ok = False
+                break
+            node = nodes_by_name.get(host)
+            if node is None or node.node is None:
+                ok = False
+                break
+            if t.pod is not None and cache.task_claim_names(t):
+                ok = False  # PVC flows keep the slow path's volume logic
+                break
+        # the slow path would gang-discard a job that cannot reach
+        # min_available — such jobs (the kernel never proposes them
+        # fully) stay on the slow path
+        if ok and gang_ready and job.ready_task_num() + len(tasks) < job.min_available:
+            ok = False
+        if ok and drf is not None and uid not in drf.job_attrs:
+            ok = False
+        if ok and ns_enabled and any(
+            t.namespace not in drf.namespace_opts for t in tasks
+        ):
+            ok = False
+        if ok:
+            eligible.add(uid)
+    if not eligible:
+        return False
+    bulk = [t for t in ordered if t.job in eligible]
+
+    # ---- single pass over the bulk tasks ----
     job_accs: Dict[str, tuple] = {}
     job_ready0: Dict[str, int] = {}
     node_rows: Dict[str, list] = {}
@@ -175,11 +238,8 @@ def try_fast_apply(
     ns_accs: Dict[str, _LaneAcc] = {}
     q_accs: Dict[str, _LaneAcc] = {}
 
-    for t in ordered:
+    for t in bulk:
         host = proposals[t.uid]
-        node = nodes_by_name.get(host)
-        if node is None or node.node is None:
-            return False
         rr = t.resreq
         rc, rm = rr.milli_cpu, rr.memory
         scal = rr.scalars
@@ -201,10 +261,7 @@ def try_fast_apply(
         if drf is not None:
             jacc = drf_accs.get(t.job)
             if jacc is None:
-                attr = drf.job_attrs.get(t.job)
-                if attr is None:
-                    return False
-                jacc = _LaneAcc(attr.allocated)
+                jacc = _LaneAcc(drf.job_attrs[t.job].allocated)
                 drf_accs[t.job] = jacc
             jacc.cpu += rc
             jacc.mem += rm
@@ -213,10 +270,7 @@ def try_fast_apply(
             if ns_enabled:
                 nacc = ns_accs.get(t.namespace)
                 if nacc is None:
-                    opt = drf.namespace_opts.get(t.namespace)
-                    if opt is None:
-                        return False
-                    nacc = _LaneAcc(opt.allocated)
+                    nacc = _LaneAcc(drf.namespace_opts[t.namespace].allocated)
                     ns_accs[t.namespace] = nacc
                 nacc.cpu += rc
                 nacc.mem += rm
@@ -258,7 +312,6 @@ def try_fast_apply(
         idle.store(node.idle)
         used.store(node.used)
 
-    gang_ready = bool(ready_chain)
     for alloc_acc, total_acc, job, tasks in job_accs.values():
         # job.allocated/total_request follow the slow path's EPISODE
         # structure: the first episode feeds until gang-ready (all its
@@ -314,18 +367,22 @@ def try_fast_apply(
 
     for pl in listers:
         tn = pl._task_nodes
-        for t in ordered:
+        for t in bulk:
             tn[t.uid] = t.node_name
         # anti-affinity sets: gate guarantees no pod (anti-)affinity terms
         # (needs_host_validation would be set), so nothing to maintain.
 
-    cache.bind_batch([(t, t.node_name) for t in ordered])
+    cache.bind_batch([(t, t.node_name) for t in bulk])
     # journal only after the batch landed — "bind" means an actual
     # cache bind, and bind_batch mutates nothing when it raises
     if ssn._trace.enabled:
-        for t in ordered:
+        for t in bulk:
             ssn._trace.decision("bind", t.uid, t.node_name)
-    return True
+    # the session-side touched sets feed the cache's snapshot clone pool
+    if hasattr(ssn, "touched_jobs"):
+        ssn.touched_jobs.update(job_accs)
+        ssn.touched_nodes.update(node_rows)
+    return len(bulk) == len(ordered)
 
 
 def _find_pod_listers(ssn: Session):

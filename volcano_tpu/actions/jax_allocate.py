@@ -78,6 +78,10 @@ def compute_task_order_replay(ssn: Session) -> List[TaskInfo]:
     def place_task(_ctx, task: TaskInfo, job) -> bool:
         order.append(task)
         touched.append((task, task.status))
+        # the unwind restores statuses exactly but job.allocated float
+        # lanes round-trip through add/sub — the clone is dirty for the
+        # snapshot reuse pool
+        ssn.touched_jobs.add(task.job)
         job.update_task_status(task, TaskStatus.Allocated)
         ssn._fire_allocate(task)
         return True
@@ -97,6 +101,11 @@ def compute_task_order_replay(ssn: Session) -> List[TaskInfo]:
     return order
 
 
+#: phase timings of the most recent execute() — read by bench.py right
+#: after the call, same single-threaded discipline as dispatch state
+last_phase_stats: Dict[str, float] = {}
+
+
 class JaxAllocateAction(Action):
     def __init__(self, weights=None, gang_rounds: int = 3):
         from volcano_tpu.ops.kernels import DEFAULT_WEIGHTS
@@ -110,7 +119,11 @@ class JaxAllocateAction(Action):
     # ---- phase 2 ----
 
     def _kernel_proposals(
-        self, ssn: Session, ordered_tasks: List[TaskInfo]
+        self,
+        ssn: Session,
+        ordered_tasks: List[TaskInfo],
+        nodes: Optional[List] = None,
+        pack_cache=None,
     ) -> Tuple[Dict[str, str], Optional[object]]:
         """Pack + run the device kernel; ({task uid → node name}, snap).
 
@@ -127,18 +140,42 @@ class JaxAllocateAction(Action):
             job = ssn.jobs.get(t.job)
             if job is not None and job.uid not in jobs:
                 jobs[job.uid] = job
-        nodes = [ssn.nodes[name] for name in sorted(ssn.nodes)]
+        if nodes is None:
+            nodes = [ssn.nodes[name] for name in sorted(ssn.nodes)]
         if not nodes or not ordered_tasks:
             return {}, None
 
+        enforce = "predicates" in ssn.predicate_fns
         t0 = time.perf_counter()
-        snap = pack_session(
-            ordered_tasks,
-            list(jobs.values()),
-            nodes,
-            enforce_pod_count="predicates" in ssn.predicate_fns,
-        )
-        metrics.update_kernel_duration("pack", time.perf_counter() - t0)
+        if pack_cache is not None and ssn.pack_epoch is not None:
+            # warm path: delta-assemble from the cycle-persistent cache
+            snap = pack_cache.pack(
+                ordered_tasks,
+                list(jobs.values()),
+                nodes,
+                ssn.pack_epoch,
+                enforce_pod_count=enforce,
+            )
+            last_phase_stats.update(pack_cache.last_stats)
+        else:
+            snap = pack_session(
+                ordered_tasks,
+                list(jobs.values()),
+                nodes,
+                enforce_pod_count=enforce,
+            )
+        pack_s = time.perf_counter() - t0
+        last_phase_stats["pack_ms"] = pack_s * 1e3
+        metrics.update_kernel_duration("pack", pack_s)
+
+        if snap.cache_key is not None:
+            # attach the device-resident mirror: only dirty rows travel
+            try:
+                from volcano_tpu.ops.device_stage import get_stager
+
+                snap.device_planes = get_stager(snap.cache_key).stage(snap)
+            except Exception as e:  # noqa: BLE001 — numpy path still valid
+                log.error("device staging failed (%s); numpy planes", e)
 
         t0 = time.perf_counter()
         # executor indirection: in-process kernels, or the compute-plane
@@ -177,11 +214,46 @@ class JaxAllocateAction(Action):
     # ---- phase 3 ----
 
     def execute(self, ssn: Session) -> None:
+        last_phase_stats.clear()
+        epoch = ssn.pack_epoch
+        pc = getattr(ssn.cache, "pack_cache", None) if epoch is not None else None
+        nodes = [ssn.nodes[name] for name in sorted(ssn.nodes)]
+
+        # Warm cycles stage the dynamic node planes BEFORE the ORDER
+        # phase: node rows don't depend on task order, so the host→device
+        # transfer runs concurrently with the pure-host ORDER replay and
+        # the remaining relay is only the (delta-sized) task planes.
+        prestaged = False
+        if pc is not None and nodes:
+            t0 = time.perf_counter()
+            pending = pc.begin_nodes(
+                nodes, epoch, "predicates" in ssn.predicate_fns
+            )
+            if pending is not None:
+                try:
+                    from volcano_tpu.ops.device_stage import get_stager
+
+                    get_stager(pc.key).prestage(
+                        pending["planes"], pending["dirty_pos"], pc.rev + 1
+                    )
+                    prestaged = True
+                except Exception as e:  # noqa: BLE001 — stage() recovers
+                    log.error("node-plane prestage failed: %s", e)
+            last_phase_stats["node_prepack_ms"] = (
+                time.perf_counter() - t0
+            ) * 1e3
+
+        t0 = time.perf_counter()
         with ssn._trace.span("jax-allocate:order", "action"):
             ordered = compute_task_order(ssn)
+        order_s = time.perf_counter() - t0
+        last_phase_stats["order_ms"] = order_s * 1e3
+        if prestaged:
+            # the window the staged transfer had to overlap host work
+            last_phase_stats["relay_overlap_ms"] = order_s * 1e3
         if not ordered:
             return
-        proposals, snap = self._kernel_proposals(ssn, ordered)
+        proposals, snap = self._kernel_proposals(ssn, ordered, nodes, pc)
 
         # Fully-placed exact sessions commit in bulk (actions/fast_apply);
         # anything outside that envelope runs the loop below.

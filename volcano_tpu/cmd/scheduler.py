@@ -29,11 +29,14 @@ class SchedulerDaemon(BaseDaemon):
         schedule_period: float = 1.0,
         scheduler_name: str = "volcano-tpu",
         gc_quiesce_period: int = 0,
+        snapshot_reuse: bool = False,
         **daemon_kw,
     ):
         super().__init__(api, period=schedule_period, **daemon_kw)
         self.cache = SchedulerCache(
-            client=SchedulerClient(api), scheduler_name=scheduler_name
+            client=SchedulerClient(api),
+            scheduler_name=scheduler_name,
+            snapshot_reuse=snapshot_reuse,
         )
         self.scheduler = Scheduler(
             self.cache, scheduler_conf_path=scheduler_conf,
@@ -71,6 +74,12 @@ def main(argv=None) -> int:
         "(0 = off)",
     )
     parser.add_argument(
+        "--snapshot-reuse", action="store_true",
+        help="reuse the previous session's untouched clones at session "
+        "open (warm-cycle optimization; relies on the shipped actions' "
+        "touched-set discipline — leave off with out-of-tree actions)",
+    )
+    parser.add_argument(
         "--warmup", action="store_true",
         help="compile the headline-bucket session kernels before the "
         "first cycle (first compile is ~20-40s on TPU; same flag as "
@@ -104,6 +113,7 @@ def main(argv=None) -> int:
             schedule_period=args.schedule_period,
             scheduler_name=args.scheduler_name,
             gc_quiesce_period=args.gc_quiesce_period,
+            snapshot_reuse=args.snapshot_reuse,
             listen_host=args.listen_host,
             listen_port=args.listen_port,
             leader_elect=args.leader_elect,

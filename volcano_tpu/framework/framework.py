@@ -38,6 +38,8 @@ def open_session(
     ssn.queues = snapshot.queues
     ssn.namespace_info = snapshot.namespace_info
     ssn.pvcs = snapshot.pvcs
+    ssn.pack_epoch = getattr(snapshot, "pack_epoch", None)
+    ssn.clone_gen = getattr(snapshot, "clone_gen", 0)
 
     # Instantiate plugins listed in tiers (framework.go:37-45).
     for tier in tiers:
@@ -52,8 +54,10 @@ def open_session(
     # Record incoming PodGroup status, filter invalid jobs at open
     # (session.go:105-129).
     for job in list(ssn.jobs.values()):
-        if job.pod_group is not None and job.pod_group.status.conditions:
-            ssn.pod_group_status[job.uid] = job.pod_group.status
+        if job.pod_group is not None:
+            ssn.pod_group_phase0[job.uid] = job.pod_group.status.phase
+            if job.pod_group.status.conditions:
+                ssn.pod_group_status[job.uid] = job.pod_group.status
 
     for plugin in ssn.plugins.values():
         start = time.perf_counter()
@@ -69,6 +73,9 @@ def open_session(
         vr = ssn.job_valid(job)
         if vr is not None:
             if not vr.pass_:
+                # rejected before any action ran — still one scheduling
+                # attempt in the reference's attempts accounting
+                metrics.register_schedule_attempt("unschedulable")
                 ssn.update_job_condition(
                     job,
                     scheduling.PodGroupCondition(
@@ -116,6 +123,14 @@ def close_session(ssn: Session) -> None:
             )
 
     JobUpdater(ssn).update_all()
+
+    # hand untouched clones back for reuse by the next snapshot (no-op
+    # unless the cache opted into snapshot_reuse) — after plugin closes
+    # and the job updater, which are the last clone-mutating steps
+    release = getattr(ssn.cache, "release_session_clones", None)
+    if release is not None:
+        release(ssn.clone_gen, ssn.touched_jobs, ssn.touched_nodes)
+
     if rec.enabled:
         rec.complete(
             "close_session", "framework", close_start,

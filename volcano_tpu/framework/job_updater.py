@@ -7,10 +7,13 @@ over 16 goroutines; host-side Python uses a thread pool for the same effect
 
 from __future__ import annotations
 
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import TYPE_CHECKING, List
 
 from volcano_tpu.api import JobInfo
+from volcano_tpu.apis import scheduling
+from volcano_tpu.metrics import metrics
 from volcano_tpu.utils.logging import get_logger
 
 if TYPE_CHECKING:
@@ -43,13 +46,38 @@ class JobUpdater:
         ssn = self.ssn
         if job.pod_group is None:
             return
+        # was the job already Running when this session OPENED?  The
+        # conditions-based pod_group_status record is empty for healthy
+        # Running groups, so the phase is snapshotted separately at open
+        # (Session.pod_group_phase0) — steady-state Running jobs must
+        # not re-count as a fresh "scheduled" attempt every cycle.
+        was_running = (
+            ssn.pod_group_phase0.get(job.uid) == scheduling.POD_GROUP_RUNNING
+        )
         job.pod_group.status = ssn.job_status(job)
         old_status = ssn.pod_group_status.get(job.uid)
-        if is_pod_group_status_updated(old_status, job.pod_group.status):
-            try:
+        # schedule_attempts_total (metrics.go:74-121): exactly ONE
+        # attempt per job the session actually worked on, bucketed by
+        # outcome (a writeback failure overrides it to "error")
+        phase = job.pod_group.status.phase
+        attempt = None
+        if phase == scheduling.POD_GROUP_RUNNING:
+            if not was_running:
+                attempt = "scheduled"
+                if job.creation_timestamp > 0:
+                    metrics.update_job_schedule_duration(
+                        max(time.time() - job.creation_timestamp, 0.0)
+                    )
+        elif job.job_fit_errors or phase == scheduling.POD_GROUP_UNKNOWN:
+            attempt = "unschedulable"
+        try:
+            if is_pod_group_status_updated(old_status, job.pod_group.status):
                 ssn.cache.update_job_status(job)
-            except Exception as e:  # noqa: BLE001 — next session retries
-                log.error("Failed to update job status <%s/%s>: %s", job.namespace, job.name, e)
+        except Exception as e:  # noqa: BLE001 — next session retries
+            attempt = "error"
+            log.error("Failed to update job status <%s/%s>: %s", job.namespace, job.name, e)
+        if attempt is not None:
+            metrics.register_schedule_attempt(attempt)
 
     def update_all(self) -> None:
         if not self.job_queue:

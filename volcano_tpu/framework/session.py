@@ -57,12 +57,29 @@ class Session:
         self._trace = trace.get_recorder()
 
         self.pod_group_status: Dict[str, scheduling.PodGroupStatus] = {}
+        #: pod-group PHASE of every job at session open — the attempts
+        #: accounting needs "was it Running before this cycle", which
+        #: the conditions-based record above cannot answer for healthy
+        #: Running groups (they carry no conditions)
+        self.pod_group_phase0: Dict[str, str] = {}
 
         self.jobs: Dict[str, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}
         self.queues: Dict[str, QueueInfo] = {}
         self.namespace_info: Dict[str, NamespaceInfo] = {}
         self.pvcs: Dict[str, object] = {}
+
+        #: change-tracking epoch of the snapshot this session computes on
+        #: (ClusterInfo.pack_epoch) — consumed by the warm packer
+        self.pack_epoch = None
+        #: clone-pool generation (cache.snapshot ↔ release_session_clones)
+        self.clone_gen: int = 0
+        #: job uids / node names whose CLONES this session mutated; every
+        #: mutating path (session ops, Statement ops, the bulk apply, the
+        #: drive loops, gang's close) records here so close_session can
+        #: hand untouched clones back for reuse
+        self.touched_jobs: set = set()
+        self.touched_nodes: set = set()
 
         self.tiers: List[Tier] = []
         self.configurations: List[Configuration] = []
@@ -383,6 +400,8 @@ class Session:
         job = self.jobs.get(task.job)
         if job is None:
             raise KeyError(f"failed to find job {task.job} when pipelining")
+        self.touched_jobs.add(task.job)
+        self.touched_nodes.add(hostname)
         job.update_task_status(task, TaskStatus.Pipelined)
         task.node_name = hostname
         node = self.nodes.get(hostname)
@@ -400,6 +419,8 @@ class Session:
         job = self.jobs.get(task.job)
         if job is None:
             raise KeyError(f"failed to find job {task.job} when allocating")
+        self.touched_jobs.add(task.job)
+        self.touched_nodes.add(hostname)
         job.update_task_status(task, TaskStatus.Allocated)
         task.node_name = hostname
         node = self.nodes.get(hostname)
@@ -437,6 +458,8 @@ class Session:
             self.cache.resync_task(task)
             return
         self.cache.bind(task, task.node_name)
+        self.touched_jobs.add(task.job)
+        self.touched_nodes.add(task.node_name)
         if self._trace.enabled:
             # one "bind" decision per actual cache.bind, same as the
             # Statement commit and fast-apply paths
@@ -449,6 +472,8 @@ class Session:
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         """session.go Evict — immediate cache eviction + Releasing status."""
         self.cache.evict(reclaimee, reason)
+        self.touched_jobs.add(reclaimee.job)
+        self.touched_nodes.add(reclaimee.node_name)
         if self._trace.enabled:
             self._trace.decision(
                 "evict", reclaimee.uid, reclaimee.node_name, reason

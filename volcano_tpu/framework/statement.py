@@ -27,6 +27,8 @@ class Statement:
     # ---- evict (statement.go:40-113) ----
 
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
+        self.ssn.touched_jobs.add(reclaimee.job)
+        self.ssn.touched_nodes.add(reclaimee.node_name)
         job = self.ssn.jobs.get(reclaimee.job)
         if job is not None:
             job.update_task_status(reclaimee, TaskStatus.Releasing)
@@ -60,6 +62,8 @@ class Statement:
     # ---- pipeline (statement.go:116-196) ----
 
     def pipeline(self, task: TaskInfo, hostname: str) -> None:
+        self.ssn.touched_jobs.add(task.job)
+        self.ssn.touched_nodes.add(hostname)
         job = self.ssn.jobs.get(task.job)
         if job is not None:
             job.update_task_status(task, TaskStatus.Pipelined)
@@ -82,6 +86,8 @@ class Statement:
     # ---- allocate (statement.go:199-305) ----
 
     def allocate(self, task: TaskInfo, hostname: str) -> None:
+        self.ssn.touched_jobs.add(task.job)
+        self.ssn.touched_nodes.add(hostname)
         self.ssn.cache.allocate_volumes(task, hostname)
         job = self.ssn.jobs.get(task.job)
         if job is None:

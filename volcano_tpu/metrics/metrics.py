@@ -19,6 +19,15 @@ _NAMESPACE = "volcano"
 # 5ms × 2^k buckets, like prometheus.ExponentialBuckets(5, 2, 10) in ms.
 _LATENCY_BUCKETS_MS = [5.0 * (2**k) for k in range(10)]
 
+# Microsecond histograms need a wider exponential range: 5µs × 2^k up to
+# ~160ms, so both a 20µs plugin callback and a 100ms action land inside
+# the bucketed range rather than in +Inf.
+_LATENCY_BUCKETS_US = [5.0 * (2**k) for k in range(16)]
+
+# Job-level end-to-end latency (creation → first scheduled cycle) is
+# seconds-to-minutes scale: 100ms × 2^k up to ~14 minutes.
+_JOB_LATENCY_BUCKETS_MS = [100.0 * (2**k) for k in range(14)]
+
 
 class _Histogram:
     def __init__(self, name: str, help_: str, buckets: List[float]):
@@ -43,12 +52,18 @@ class _Registry:
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = defaultdict(float)
         self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
 
-    def histogram(self, name: str, labels: Dict[str, str], help_: str = "") -> _Histogram:
+    def histogram(
+        self,
+        name: str,
+        labels: Dict[str, str],
+        help_: str = "",
+        buckets: List[float] = None,
+    ) -> _Histogram:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             h = self._histograms.get(key)
             if h is None:
-                h = _Histogram(name, help_, _LATENCY_BUCKETS_MS)
+                h = _Histogram(name, help_, buckets or _LATENCY_BUCKETS_MS)
                 self._histograms[key] = h
             return h
 
@@ -100,17 +115,26 @@ registry = _Registry()
 
 
 # ---- update helpers (metrics.go:124-171) ----
+# Unit discipline (metrics.go:47-72): *_microseconds histograms observe
+# seconds × 1e6, *_milliseconds histograms seconds × 1e3.  The first
+# four releases observed ms into the µs histograms — every exported
+# plugin/action/task latency was 1000× off (tests/test_metrics.py pins
+# the units now).
 
 def update_plugin_duration(plugin_name: str, seconds: float) -> None:
     registry.histogram(
-        f"{_NAMESPACE}_plugin_scheduling_latency_microseconds", {"plugin": plugin_name}
-    ).observe(seconds * 1e3)
+        f"{_NAMESPACE}_plugin_scheduling_latency_microseconds",
+        {"plugin": plugin_name},
+        buckets=_LATENCY_BUCKETS_US,
+    ).observe(seconds * 1e6)
 
 
 def update_action_duration(action_name: str, seconds: float) -> None:
     registry.histogram(
-        f"{_NAMESPACE}_action_scheduling_latency_microseconds", {"action": action_name}
-    ).observe(seconds * 1e3)
+        f"{_NAMESPACE}_action_scheduling_latency_microseconds",
+        {"action": action_name},
+        buckets=_LATENCY_BUCKETS_US,
+    ).observe(seconds * 1e6)
 
 
 def update_e2e_duration(seconds: float) -> None:
@@ -119,10 +143,28 @@ def update_e2e_duration(seconds: float) -> None:
     ).observe(seconds * 1e3)
 
 
+def update_job_schedule_duration(seconds: float) -> None:
+    """Per-job end-to-end scheduling latency (creation → first scheduled
+    cycle), the reference's e2e_job_scheduling_latency_milliseconds."""
+    registry.histogram(
+        f"{_NAMESPACE}_e2e_job_scheduling_latency_milliseconds",
+        {},
+        buckets=_JOB_LATENCY_BUCKETS_MS,
+    ).observe(seconds * 1e3)
+
+
 def update_task_schedule_duration(seconds: float) -> None:
     registry.histogram(
-        f"{_NAMESPACE}_task_scheduling_latency_microseconds", {}
-    ).observe(seconds * 1e3)
+        f"{_NAMESPACE}_task_scheduling_latency_microseconds",
+        {},
+        buckets=_LATENCY_BUCKETS_US,
+    ).observe(seconds * 1e6)
+
+
+def register_schedule_attempt(result: str) -> None:
+    """metrics.go schedule_attempts_total: one count per job scheduling
+    attempt, result ∈ {scheduled, unschedulable, error}."""
+    registry.inc(f"{_NAMESPACE}_schedule_attempts_total", {"result": result})
 
 
 def update_pod_schedule_status(status: str, count: int = 1) -> None:

@@ -352,6 +352,46 @@ def prepare_blocked_arrays(snap: PackedSnapshot, block_size: int = 64):
     return arrays, T_blk
 
 
+def _prepare_blocked_dev(snap: PackedSnapshot, block_size: int):
+    """Device-side equivalent of prepare_blocked_arrays: identical pad
+    values (task blocks zero-filled, one infeasible sentinel node row),
+    concatenated on device from the staged planes."""
+    from volcano_tpu.ops.device_stage import device_plane
+
+    T_blk, _ = task_block_padding(snap, block_size)
+    T_pad = snap.task_resreq.shape[0]
+    task_feas_class, class_sel, class_tol = _feasibility_classes(snap)
+
+    def pad_tasks(arr, fill=0):
+        arr = jnp.asarray(arr)
+        pad = jnp.full((T_blk - T_pad, *arr.shape[1:]), fill, arr.dtype)
+        return jnp.concatenate([arr, pad], axis=0)
+
+    def pad_nodes(arr, fill=0):
+        arr = jnp.asarray(arr)
+        pad = jnp.full((1, *arr.shape[1:]), fill, arr.dtype)
+        return jnp.concatenate([arr, pad], axis=0)
+
+    dev = dict(
+        task_resreq=pad_tasks(device_plane(snap, "task_resreq")),
+        task_job=pad_tasks(device_plane(snap, "task_job")),
+        task_feas_class=pad_tasks(task_feas_class),
+        class_sel_bits=jnp.asarray(class_sel),
+        class_tol_bits=jnp.asarray(class_tol),
+        node_idle=pad_nodes(device_plane(snap, "node_idle")),
+        node_used=pad_nodes(device_plane(snap, "node_used")),
+        node_alloc=pad_nodes(device_plane(snap, "node_alloc")),
+        node_label_bits=pad_nodes(device_plane(snap, "node_label_bits")),
+        node_taint_bits=pad_nodes(device_plane(snap, "node_taint_bits")),
+        node_ok=pad_nodes(device_plane(snap, "node_ok"), fill=False),
+        node_task_count=pad_nodes(device_plane(snap, "node_task_count")),
+        node_max_tasks=pad_nodes(device_plane(snap, "node_max_tasks")),
+        job_min_available=jnp.asarray(device_plane(snap, "job_min_available")),
+        tolerance=jnp.asarray(device_plane(snap, "tolerance")),
+    )
+    return dev, T_blk
+
+
 def run_packed_blocked(
     snap: PackedSnapshot,
     weights: ScoreWeights = DEFAULT_WEIGHTS,
@@ -364,8 +404,18 @@ def run_packed_blocked(
     if not f32_lr_exact(snap):
         weights = weights._replace(lr_int_exact=True)
 
-    arrays, T_blk = prepare_blocked_arrays(snap, block_size)
-    dev = {k: jnp.asarray(v) for k, v in arrays.items()}
+    if getattr(snap, "device_planes", None):
+        # staged session (ops/device_stage.py): planes are already
+        # device-resident — pad on device so the host ships nothing but
+        # the dirty-row scatters already applied by the stager
+        dev, T_blk = _prepare_blocked_dev(snap, block_size)
+        # the gang fixpoint walks task_job host-side
+        task_job_host = np.zeros(T_blk, dtype=snap.task_job.dtype)
+        task_job_host[: snap.task_job.shape[0]] = snap.task_job
+    else:
+        arrays, T_blk = prepare_blocked_arrays(snap, block_size)
+        dev = {k: jnp.asarray(v) for k, v in arrays.items()}
+        task_job_host = arrays["task_job"]
 
     def run_pass(active):
         return schedule_pass_blocked(
@@ -392,7 +442,7 @@ def run_packed_blocked(
 
     return gang_fixpoint(
         run_pass,
-        arrays["task_job"],
+        task_job_host,
         snap.job_min_available,
         snap.job_ready_count,
         snap.n_tasks,

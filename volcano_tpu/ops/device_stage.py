@@ -1,0 +1,194 @@
+"""Device-resident session planes with delta scatter staging.
+
+The warm packer (ops/pack_cache.py) knows exactly which rows of which
+planes changed since the previous cycle; this module keeps the previous
+cycle's planes resident on the device and applies those deltas with a
+jitted ``buf.at[rows].set(new_rows)`` scatter instead of re-shipping
+full arrays.  Staging is asynchronous by construction — ``device_put``
+and the scatter dispatch return immediately — so jax-allocate kicks the
+dynamic node planes here *before* its ORDER phase and the transfer runs
+concurrently with host work (the "relay overlap" of the warm cycle).
+
+Consumers (ops/kernels.run_packed, ops/blocked.run_packed_blocked) pick
+the staged buffer up through ``PackedSnapshot.device_planes`` and fall
+back to the numpy plane when absent, so every path works unchanged
+without a stager.  The Pallas executor keeps its own content-addressed
+device cluster buffer (ops/pallas_session._cached_cluster_buf) — its
+plane layout is transposed/byte-packed and is cached at that layer.
+
+Safety contract: the packer never mutates a plane array after handing
+it to ``prestage``/``stage`` (each pack assembles fresh arrays), so the
+async host→device reads can never observe a torn write.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import numpy as np
+
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: planes mirrored on device.  task_sel/tol bit planes are not listed:
+#: the kernels ship compressed feasibility classes instead
+#: (ops/kernels._feasibility_classes), which are derived host-side.
+STAGED_PLANES = (
+    "task_resreq",
+    "task_job",
+    "node_idle",
+    "node_used",
+    "node_alloc",
+    "node_label_bits",
+    "node_taint_bits",
+    "node_ok",
+    "node_task_count",
+    "node_max_tasks",
+    "job_min_available",
+    "job_ready_count",
+    "tolerance",
+)
+
+#: dynamic node planes safe to stage before the task pass (nothing in
+#: the task pass can change them — label back-patching only touches
+#: node_label_bits, which is deliberately NOT in this set)
+PRESTAGE_PLANES = ("node_idle", "node_used", "node_task_count", "node_ok")
+
+
+@functools.lru_cache(maxsize=1)
+def _donate_ok() -> bool:
+    import jax
+
+    # CPU ignores donation and warns per call — skip it there
+    return jax.default_backend() != "cpu"
+
+
+@functools.lru_cache(maxsize=4)
+def _scatter_fn(donate: bool):
+    import jax
+
+    def scatter(buf, rows, vals):
+        return buf.at[rows].set(vals)
+
+    return jax.jit(scatter, donate_argnums=(0,) if donate else ())
+
+
+class DeviceStager:
+    """Per-PackCache device mirror of the staged planes."""
+
+    def __init__(self, cache_key: str):
+        self.cache_key = cache_key
+        self.bufs: Dict[str, object] = {}
+        self.plane_rev: Dict[str, int] = {}
+
+    def _put(self, name: str, arr: np.ndarray, rev: int):
+        import jax
+
+        buf = jax.device_put(arr)
+        self.bufs[name] = buf
+        self.plane_rev[name] = rev
+        return buf
+
+    def _apply(self, name: str, arr: np.ndarray, delta, rev: int):
+        """Bring plane ``name`` to revision ``rev`` (content ``arr``)."""
+        import jax.numpy as jnp
+
+        buf = self.bufs.get(name)
+        if (
+            buf is not None
+            and self.plane_rev.get(name) == rev
+            and buf.shape == arr.shape
+        ):
+            return buf  # already staged this revision (prestage)
+        if (
+            delta is not None
+            and buf is not None
+            and self.plane_rev.get(name) == delta.base_rev
+            and buf.shape == arr.shape
+            and buf.dtype == arr.dtype
+        ):
+            if name not in delta.planes:
+                self.plane_rev[name] = rev
+                return buf  # byte-identical to the previous revision
+            rows = delta.planes[name]
+            if rows is not None and rows.size:
+                buf = _scatter_fn(_donate_ok())(
+                    buf, jnp.asarray(rows), jnp.asarray(arr[rows])
+                )
+                self.bufs[name] = buf
+                self.plane_rev[name] = rev
+                return buf
+            if rows is not None:  # zero-row delta — nothing moved
+                self.plane_rev[name] = rev
+                return buf
+        return self._put(name, arr, rev)
+
+    def prestage(self, planes: Dict[str, np.ndarray], delta_rows, rev: int) -> None:
+        """Kick async staging of the dynamic node planes (called before
+        ORDER).  ``delta_rows`` is the dirty-node row index array — used
+        as a scatter when the resident buffers are at ``rev - 1``."""
+        import jax.numpy as jnp
+
+        for name in PRESTAGE_PLANES:
+            arr = planes.get(name)
+            if arr is None:
+                continue
+            buf = self.bufs.get(name)
+            if (
+                buf is not None
+                and self.plane_rev.get(name) == rev - 1
+                and buf.shape == arr.shape
+                and buf.dtype == arr.dtype
+            ):
+                if delta_rows is not None and delta_rows.size:
+                    buf = _scatter_fn(_donate_ok())(
+                        buf, jnp.asarray(delta_rows), jnp.asarray(arr[delta_rows])
+                    )
+                    self.bufs[name] = buf
+                self.plane_rev[name] = rev
+            else:
+                self._put(name, arr, rev)
+
+    def stage(self, snap) -> Dict[str, object]:
+        """Bring every staged plane to ``snap.rev``; returns the device
+        plane dict to attach as ``snap.device_planes``."""
+        delta = snap.delta
+        if delta is None:
+            # cold / wholesale pack — any prestaged revision stamps are
+            # meaningless, restage everything
+            self.bufs.clear()
+            self.plane_rev.clear()
+        out = {}
+        for name in STAGED_PLANES:
+            arr = getattr(snap, name)
+            if arr is None:
+                continue
+            out[name] = self._apply(name, arr, delta, snap.rev)
+        return out
+
+
+_stagers: Dict[str, DeviceStager] = {}
+
+
+def get_stager(cache_key: str) -> DeviceStager:
+    """Process-level stager registry, one per PackCache, bounded."""
+    st = _stagers.get(cache_key)
+    if st is None:
+        if len(_stagers) >= 8:  # caches come and go in tests — bound VRAM
+            _stagers.pop(next(iter(_stagers)))
+        st = _stagers[cache_key] = DeviceStager(cache_key)
+    return st
+
+
+def device_plane(snap, name: str):
+    """The staged device buffer for ``name`` when present, else the
+    numpy plane — the helper kernels use so staged sessions skip the
+    host→device copy transparently."""
+    planes = getattr(snap, "device_planes", None)
+    if planes is not None:
+        buf = planes.get(name)
+        if buf is not None:
+            return buf
+    return getattr(snap, name)

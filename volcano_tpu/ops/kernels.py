@@ -485,24 +485,30 @@ def run_packed(
         weights = weights._replace(lr_int_exact=True)
 
     task_feas_class, class_sel, class_tol = _feasibility_classes(snap)
+    # staged sessions (ops/device_stage.py) resolve most planes to
+    # device-resident buffers here — jnp.asarray is then a no-op and the
+    # session ships only the dirty-row scatters plus the derived
+    # feasibility-class arrays
+    from volcano_tpu.ops.device_stage import device_plane as _dp
+
     dev = [
         jnp.asarray(x)
         for x in (
-            snap.task_resreq,
-            snap.task_job,
+            _dp(snap, "task_resreq"),
+            _dp(snap, "task_job"),
             task_feas_class,
             class_sel,
             class_tol,
-            snap.node_idle,
-            snap.node_used,
-            snap.node_alloc,
-            snap.node_label_bits,
-            snap.node_taint_bits,
-            snap.node_ok,
-            snap.node_task_count,
-            snap.node_max_tasks,
-            snap.job_min_available,
-            snap.tolerance,
+            _dp(snap, "node_idle"),
+            _dp(snap, "node_used"),
+            _dp(snap, "node_alloc"),
+            _dp(snap, "node_label_bits"),
+            _dp(snap, "node_taint_bits"),
+            _dp(snap, "node_ok"),
+            _dp(snap, "node_task_count"),
+            _dp(snap, "node_max_tasks"),
+            _dp(snap, "job_min_available"),
+            _dp(snap, "tolerance"),
         )
     ]
     task_job = snap.task_job

@@ -126,6 +126,27 @@ class PackedSnapshot:
     #: cannot score; jax-allocate routes these to the host path.
     task_has_preferences: np.ndarray = None
 
+    #: [T] bool — per-row needs_host_validation contribution (the OR of
+    #: this plus registry overflow is ``needs_host_validation``).  Host
+    #: bookkeeping for the warm packer; not serialized.
+    task_needs_host: np.ndarray = None
+
+    # ---- warm-cycle metadata (volcano_tpu/ops/pack_cache.py) ----
+    #: identity of the producing PackCache (None for cold one-shot packs);
+    #: device stagers and the compute-plane delta protocol key their
+    #: persistent buffers on it.  NOT serialized (journal/wire carry the
+    #: fully materialized arrays, so trace.replay.verify is delta-blind).
+    cache_key: Optional[str] = None
+    #: monotonically increasing pack revision within the cache_key
+    rev: int = 0
+    #: PackDelta describing which rows changed since ``rev - 1``; None on
+    #: cold packs and whenever the cache invalidated wholesale
+    delta: Optional[object] = None
+    #: optional {plane name → device array} mirror staged ahead of the
+    #: kernel call (ops/device_stage.py); consumers fall back to the
+    #: numpy planes when absent
+    device_planes: Optional[Dict[str, object]] = None
+
     @property
     def shape_key(self) -> Tuple[int, int, int, int, int]:
         return (
@@ -249,41 +270,21 @@ def _res_vec(res, names: List[str], snap: "PackedSnapshot") -> np.ndarray:
     return out
 
 
-def pack_session(
-    tasks: Sequence[TaskInfo],
-    jobs: Sequence[JobInfo],
-    nodes: Sequence[NodeInfo],
-    bit_words: int = DEFAULT_BIT_WORDS,
-    pad: bool = True,
-    enforce_pod_count: bool = True,
-) -> PackedSnapshot:
-    """Pack pending tasks (in processing order), their jobs and all nodes.
-
-    ``tasks`` must arrive in the order the kernel should consider them —
-    the host computes it from the session's task/job order functions, which
-    preserves the reference's priority semantics (allocate.go:54-92).
-
-    ``enforce_pod_count`` mirrors whether the predicates plugin is in the
-    session's tiers: the pod-number limit lives there (predicates.go:164),
-    so without it the host never counts pods and neither should the kernel.
-    """
-    snap = PackedSnapshot()
-    names, tol = _resource_axis(tasks, nodes)
-    snap.resource_names = names
-    snap.tolerance = tol
-    R = len(names)
-
-    T, N, J = len(tasks), len(nodes), len(jobs)
-    T_pad = _bucket(T) if pad else max(T, 1)
-    N_pad = _bucket(N) if pad else max(N, 1)
-    J_pad = _bucket(J, minimum=16) if pad else max(J, 1)
-
-    job_index = {j.uid: i for i, j in enumerate(jobs)}
-
-    label_reg = BitRegistry(bit_words)
-    taint_reg = BitRegistry(bit_words)
-    W = bit_words
-
+def alloc_planes(
+    snap: "PackedSnapshot",
+    R: int,
+    W: int,
+    T: int,
+    N: int,
+    J: int,
+    T_pad: int,
+    N_pad: int,
+    J_pad: int,
+) -> None:
+    """Allocate every plane of a PackedSnapshot zeroed at the given
+    padded shapes — the single copy shared by pack_session and the warm
+    packer's assembly (ops/pack_cache.py), so a new plane cannot be
+    added to one and silently missed by the other."""
     snap.n_tasks, snap.n_nodes, snap.n_jobs = T, N, J
     snap.task_resreq = np.zeros((T_pad, R), dtype=np.float32)
     snap.task_job = np.zeros(T_pad, dtype=np.int32)
@@ -302,6 +303,224 @@ def pack_session(
     snap.job_min_available[J:] = np.iinfo(np.int32).max
     snap.job_ready_count = np.zeros(J_pad, dtype=np.int32)
     snap.task_has_preferences = np.zeros(T_pad, dtype=bool)
+    snap.task_needs_host = np.zeros(T_pad, dtype=bool)
+
+
+def pack_task_bits(
+    snap: "PackedSnapshot",
+    i: int,
+    t: TaskInfo,
+    label_reg: BitRegistry,
+    taint_reg: BitRegistry,
+) -> bool:
+    """Selector/affinity/toleration bit packing for one ordered task —
+    the single copy shared by the cold pack loop and the warm delta
+    packer's dirty-row repack (ops/pack_cache.py), so the two cannot
+    drift.  Writes the task's sel/tol bit rows and preference flag into
+    ``snap`` at row ``i``; returns True when the task needs host
+    validation (affinity richer than the bitset encoding)."""
+    needs_host = False
+    pod = t.pod
+    if pod is None:
+        return needs_host
+    for k, v in (pod.spec.node_selector or {}).items():
+        label_reg.set_bit(snap.task_sel_bits, i, (k, v))
+    # Required node affinity: single-term all-In expressions fold into
+    # the selector bitset; anything richer flags host validation.
+    node_aff = (pod.spec.affinity or {}).get("nodeAffinity") or {}
+    req = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+    terms = req.get("nodeSelectorTerms") or []
+    if len(terms) == 1:
+        for e in terms[0].get("matchExpressions") or []:
+            if e.get("operator", "In") == "In" and len(e.get("values") or []) == 1:
+                label_reg.set_bit(
+                    snap.task_sel_bits, i, (e["key"], e["values"][0])
+                )
+            else:
+                needs_host = True
+    elif terms:
+        needs_host = True
+    for tol_ in pod.spec.tolerations or []:
+        if tol_.operator == "Exists" and not tol_.key:
+            # tolerates everything: set all taint bits
+            snap.task_tol_bits[i, :] = np.uint32(0xFFFFFFFF)
+        elif tol_.operator == "Exists":
+            pass  # keyed Exists resolved in the post-node pass
+        else:
+            for effect in ("NoSchedule", "NoExecute"):
+                if not tol_.effect or tol_.effect == effect:
+                    taint_reg.set_bit(
+                        snap.task_tol_bits, i, (tol_.key, tol_.value, effect)
+                    )
+    aff = pod.spec.affinity or {}
+    if aff.get("podAffinity") or aff.get("podAntiAffinity"):
+        needs_host = True
+    node_pref = (aff.get("nodeAffinity") or {}).get(
+        "preferredDuringSchedulingIgnoredDuringExecution"
+    )
+    pod_pref = (aff.get("podAffinity") or {}).get(
+        "preferredDuringSchedulingIgnoredDuringExecution"
+    ) or (aff.get("podAntiAffinity") or {}).get(
+        "preferredDuringSchedulingIgnoredDuringExecution"
+    )
+    if node_pref or pod_pref:
+        # Preference terms contribute to host scoring (nodeorder.py);
+        # the kernel has no lanes for them — route to host path.
+        snap.task_has_preferences[i] = True
+    return needs_host
+
+
+def task_exists_tolerations(t: TaskInfo) -> Tuple[Tuple[str, str], ...]:
+    """(key, effect) pairs of the task's keyed Exists tolerations — what
+    resolve_exists_tolerations matches against the taint registry.  The
+    warm packer caches this per row so it can re-resolve only affected
+    tasks when a dirty node registers a new taint."""
+    pod = t.pod
+    if pod is None:
+        return ()
+    out = []
+    for tol_ in pod.spec.tolerations or []:
+        if tol_.operator == "Exists" and tol_.key:
+            out.append((tol_.key, tol_.effect or ""))
+    return tuple(out)
+
+
+def resolve_exists_tolerations(
+    snap: "PackedSnapshot", indexed_tasks, taint_reg: BitRegistry
+) -> None:
+    """Set tol bits for keyed Exists tolerations against the (complete)
+    taint registry, for each ``(row, task)`` in ``indexed_tasks``."""
+    for i, t in indexed_tasks:
+        pod = t.pod
+        if pod is None:
+            continue
+        for tol_ in pod.spec.tolerations or []:
+            if tol_.operator == "Exists" and tol_.key:
+                for (k, v, eff), idx in taint_reg.index.items():
+                    if k == tol_.key and (not tol_.effect or tol_.effect == eff):
+                        snap.task_tol_bits[i, idx // 32] |= np.uint32(1 << (idx % 32))
+
+
+def pack_node_row(
+    snap: "PackedSnapshot",
+    i: int,
+    n: NodeInfo,
+    label_reg: BitRegistry,
+    taint_reg: BitRegistry,
+    enforce_pod_count: bool,
+) -> None:
+    """Non-lane node state for one row: ok flag, task counts, label/taint
+    bits.  Shared by the cold pack loop and the warm packer's dirty-node
+    repack."""
+    snap.node_ok[i] = n.ready() and not (
+        n.node is not None and n.node.spec.unschedulable
+    )
+    snap.node_task_count[i] = len(n.tasks)
+    # Host semantics: the pod-count limit is the predicates plugin's
+    # (max_task_num 0 ⇒ it rejects everything); without that plugin
+    # no limit applies.
+    snap.node_max_tasks[i] = (
+        n.allocatable.max_task_num if enforce_pod_count else np.iinfo(np.int32).max
+    )
+    if n.node is None:
+        return
+    for k, v in (n.node.metadata.labels or {}).items():
+        # Only label pairs some task references need bits.
+        if (k, v) in label_reg.index:
+            label_reg.set_bit(snap.node_label_bits, i, (k, v))
+    for taint in n.node.spec.taints or []:
+        if taint.effect in ("NoSchedule", "NoExecute"):
+            taint_reg.set_bit(
+                snap.node_taint_bits, i, (taint.key, taint.value, taint.effect)
+            )
+
+
+def task_lane_row(t: TaskInfo, names: List[str], row: np.ndarray) -> bool:
+    """Fill one task's resreq lane row (same float op order as the cold
+    bulk extraction: f64 memory divide, then f32 downcast on store).
+    Returns False when the memory quantity was not MiB-aligned."""
+    rr = t.init_resreq
+    row[0] = rr.milli_cpu
+    row[1] = rr.memory / MIB
+    sc = rr.scalars
+    if sc and len(names) > 2:
+        for r, name in enumerate(names[2:], start=2):
+            row[r] = sc.get(name, 0.0)
+    return not rr.memory % MIB
+
+
+def node_lane_rows(
+    n: NodeInfo,
+    names: List[str],
+    idle_row: np.ndarray,
+    used_row: np.ndarray,
+    alloc_row: np.ndarray,
+) -> bool:
+    """Fill one node's idle/used/alloc lane rows; returns False when any
+    memory quantity was not MiB-aligned."""
+    mem_ok = True
+    for res, row in ((n.idle, idle_row), (n.used, used_row), (n.allocatable, alloc_row)):
+        row[0] = res.milli_cpu
+        row[1] = res.memory / MIB
+        if res.memory % MIB:
+            mem_ok = False
+        sc = res.scalars
+        if sc and len(names) > 2:
+            for r, name in enumerate(names[2:], start=2):
+                row[r] = sc.get(name, 0.0)
+    return mem_ok
+
+
+def pack_session(
+    tasks: Sequence[TaskInfo],
+    jobs: Sequence[JobInfo],
+    nodes: Sequence[NodeInfo],
+    bit_words: int = DEFAULT_BIT_WORDS,
+    pad: bool = True,
+    enforce_pod_count: bool = True,
+    label_registry: Optional[BitRegistry] = None,
+    taint_registry: Optional[BitRegistry] = None,
+) -> PackedSnapshot:
+    """Pack pending tasks (in processing order), their jobs and all nodes.
+
+    ``tasks`` must arrive in the order the kernel should consider them —
+    the host computes it from the session's task/job order functions, which
+    preserves the reference's priority semantics (allocate.go:54-92).
+
+    ``enforce_pod_count`` mirrors whether the predicates plugin is in the
+    session's tiers: the pod-number limit lives there (predicates.go:164),
+    so without it the host never counts pods and neither should the kernel.
+
+    ``label_registry``/``taint_registry`` seed the bit assignment with a
+    persistent registry (ops/pack_cache.py).  Bit indices are append-only,
+    so a pack seeded with a registry that already covers the session's
+    label/taint pairs produces arrays bit-identical to the pack that
+    built the registry — the equivalence contract the warm delta path is
+    tested against (tests/test_pack_cache.py).  Note the contract is
+    dictionary-level: a warm pack may FIRST-register new pairs in a
+    different order than a cold pack would (it packs nodes before tasks
+    for relay overlap), so equivalence is defined against a cold pack
+    seeded with the resulting registry; bindings are invariant under bit
+    permutation either way.
+    """
+    snap = PackedSnapshot()
+    names, tol = _resource_axis(tasks, nodes)
+    snap.resource_names = names
+    snap.tolerance = tol
+    R = len(names)
+
+    T, N, J = len(tasks), len(nodes), len(jobs)
+    T_pad = _bucket(T) if pad else max(T, 1)
+    N_pad = _bucket(N) if pad else max(N, 1)
+    J_pad = _bucket(J, minimum=16) if pad else max(J, 1)
+
+    job_index = {j.uid: i for i, j in enumerate(jobs)}
+
+    label_reg = label_registry if label_registry is not None else BitRegistry(bit_words)
+    taint_reg = taint_registry if taint_registry is not None else BitRegistry(bit_words)
+    W = label_reg.words
+
+    alloc_planes(snap, R, W, T, N, J, T_pad, N_pad, J_pad)
 
     # Resource lanes: bulk-extract cpu/memory (the dominant cost at 50k
     # tasks was one tiny np array per task); scalar lanes stay per-task
@@ -323,53 +542,9 @@ def pack_session(
     # Tasks: selector/affinity/toleration bits come from the pod spec.
     for i, t in enumerate(tasks):
         snap.task_uids.append(t.uid)
-        pod = t.pod
-        if pod is None:
-            continue
-        for k, v in (pod.spec.node_selector or {}).items():
-            label_reg.set_bit(snap.task_sel_bits, i, (k, v))
-        # Required node affinity: single-term all-In expressions fold into
-        # the selector bitset; anything richer flags host validation.
-        node_aff = (pod.spec.affinity or {}).get("nodeAffinity") or {}
-        req = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
-        terms = req.get("nodeSelectorTerms") or []
-        if len(terms) == 1:
-            for e in terms[0].get("matchExpressions") or []:
-                if e.get("operator", "In") == "In" and len(e.get("values") or []) == 1:
-                    label_reg.set_bit(
-                        snap.task_sel_bits, i, (e["key"], e["values"][0])
-                    )
-                else:
-                    snap.needs_host_validation = True
-        elif terms:
+        if pack_task_bits(snap, i, t, label_reg, taint_reg):
+            snap.task_needs_host[i] = True
             snap.needs_host_validation = True
-        for tol_ in pod.spec.tolerations or []:
-            if tol_.operator == "Exists" and not tol_.key:
-                # tolerates everything: set all taint bits
-                snap.task_tol_bits[i, :] = np.uint32(0xFFFFFFFF)
-            elif tol_.operator == "Exists":
-                pass  # keyed Exists resolved in the post-node pass below
-            else:
-                for effect in ("NoSchedule", "NoExecute"):
-                    if not tol_.effect or tol_.effect == effect:
-                        taint_reg.set_bit(
-                            snap.task_tol_bits, i, (tol_.key, tol_.value, effect)
-                        )
-        aff = pod.spec.affinity or {}
-        if aff.get("podAffinity") or aff.get("podAntiAffinity"):
-            snap.needs_host_validation = True
-        node_pref = (aff.get("nodeAffinity") or {}).get(
-            "preferredDuringSchedulingIgnoredDuringExecution"
-        )
-        pod_pref = (aff.get("podAffinity") or {}).get(
-            "preferredDuringSchedulingIgnoredDuringExecution"
-        ) or (aff.get("podAntiAffinity") or {}).get(
-            "preferredDuringSchedulingIgnoredDuringExecution"
-        )
-        if node_pref or pod_pref:
-            # Preference terms contribute to host scoring (nodeorder.py);
-            # the kernel has no lanes for them — route to host path.
-            snap.task_has_preferences[i] = True
 
     # Nodes: same bulk lane extraction as tasks.
     if N:
@@ -391,40 +566,12 @@ def pack_session(
                             arr[i, k] = r.scalars.get(name, 0.0)
 
     for i, n in enumerate(nodes):
-        snap.node_ok[i] = n.ready() and not (
-            n.node is not None and n.node.spec.unschedulable
-        )
-        snap.node_task_count[i] = len(n.tasks)
-        # Host semantics: the pod-count limit is the predicates plugin's
-        # (max_task_num 0 ⇒ it rejects everything); without that plugin
-        # no limit applies.
-        snap.node_max_tasks[i] = (
-            n.allocatable.max_task_num if enforce_pod_count else np.iinfo(np.int32).max
-        )
+        pack_node_row(snap, i, n, label_reg, taint_reg, enforce_pod_count)
         snap.node_names.append(n.name)
-        if n.node is None:
-            continue
-        for k, v in (n.node.metadata.labels or {}).items():
-            # Only label pairs some task references need bits.
-            if (k, v) in label_reg.index:
-                label_reg.set_bit(snap.node_label_bits, i, (k, v))
-        for taint in n.node.spec.taints or []:
-            if taint.effect in ("NoSchedule", "NoExecute"):
-                taint_reg.set_bit(
-                    snap.node_taint_bits, i, (taint.key, taint.value, taint.effect)
-                )
 
     # Keyed Exists tolerations need the full taint registry, which is only
     # complete after the node pass.
-    for i, t in enumerate(tasks):
-        pod = t.pod
-        if pod is None:
-            continue
-        for tol_ in pod.spec.tolerations or []:
-            if tol_.operator == "Exists" and tol_.key:
-                for (k, v, eff), idx in taint_reg.index.items():
-                    if k == tol_.key and (not tol_.effect or tol_.effect == eff):
-                        snap.task_tol_bits[i, idx // 32] |= np.uint32(1 << (idx % 32))
+    resolve_exists_tolerations(snap, enumerate(tasks), taint_reg)
 
     # Jobs.
     for i, j in enumerate(jobs):

@@ -88,7 +88,12 @@ def _sharded_blocked_kernel(
     every shard (inputs to the replicated scan are gathered, hence
     bit-identical)."""
     my = jax.lax.axis_index(AXIS)
-    n_dev = jax.lax.axis_size(AXIS)
+    # jax.lax.axis_size is recent API; psum of 1 over the axis is the
+    # portable equivalent (constant-folded at trace time)
+    if hasattr(jax.lax, "axis_size"):
+        n_dev = jax.lax.axis_size(AXIS)
+    else:
+        n_dev = jax.lax.psum(1, AXIS)
     n_loc1 = node_idle.shape[0]
     n_loc = n_loc1 - 1  # real rows; row n_loc is the infeasible dummy
     T = task_resreq.shape[0]
@@ -277,7 +282,16 @@ def make_sharded_session(
         top_k=top_k,
     )
 
-    sharded = jax.shard_map(
+    # jax.shard_map is recent API; older jax ships it under
+    # jax.experimental with `check_rep` instead of `check_vma`
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None or not callable(shard_map):
+        from jax.experimental.shard_map import shard_map
+    import inspect
+
+    _params = inspect.signature(shard_map).parameters
+    _check_kw = {"check_vma": False} if "check_vma" in _params else {"check_rep": False}
+    sharded = shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -299,7 +313,7 @@ def make_sharded_session(
             rep1,  # active
         ),
         out_specs=(rep1, rep1),
-        check_vma=False,
+        **_check_kw,
     )
     return jax.jit(sharded)
 

@@ -98,6 +98,7 @@ class GangPlugin(Plugin):
                 f"{job.fit_error()}"
             )
             job.job_fit_errors = msg
+            ssn.touched_jobs.add(job.uid)
             unschedule_job_count += 1
             metrics.update_unschedule_task_count(job.name, int(unready))
             metrics.register_job_retries(job.name)

@@ -49,6 +49,11 @@ T_PREEMPT_RESP = 4
 T_PING = 5
 T_PONG = 6
 T_ERROR = 7
+#: delta frame: only the rows that changed since the session revision
+#: the server already holds (see ops/pack_cache.PackDelta)
+T_ALLOC_DELTA_REQ = 8
+#: server's "I don't hold your base revision" — client re-sends full
+T_NEED_FULL = 9
 
 _HEADER = struct.Struct("<4sHHI")
 
@@ -95,20 +100,73 @@ def _unpack_arrays(payload: bytes) -> Tuple[Dict, Dict[str, np.ndarray]]:
 def serialize_snapshot(snap) -> bytes:
     meta = {k: getattr(snap, k) for k in _SNAP_META}
     meta["resource_names"] = list(snap.resource_names)
+    # warm-session identity: lets the server retain the snapshot so the
+    # NEXT session can ship a delta frame.  Old servers ignore the keys.
+    if getattr(snap, "cache_key", None):
+        meta["cache_key"] = snap.cache_key
+        meta["rev"] = snap.rev
     arrays = {k: getattr(snap, k) for k in _SNAP_ARRAYS}
     return _pack_arrays(meta, arrays)
 
 
 def deserialize_snapshot(payload: bytes):
+    meta, arrays = _unpack_arrays(payload)
+    return _snapshot_from(meta, arrays), meta
+
+
+def _snapshot_from(meta: Dict, arrays: Dict[str, np.ndarray]):
     from volcano_tpu.ops.packing import PackedSnapshot
 
-    meta, arrays = _unpack_arrays(payload)
     snap = PackedSnapshot()
     for k in _SNAP_META:
         setattr(snap, k, meta[k])
     snap.resource_names = list(meta["resource_names"])
     for k, v in arrays.items():
         setattr(snap, k, v)
+    return snap
+
+
+def serialize_delta(snap) -> bytes:
+    """Delta frame payload: scalar meta + per-plane changes.  A plane is
+    shipped as ``full__<name>`` (replace), or as ``idx__<name>`` +
+    ``row__<name>`` (scatter into the server-held copy); planes absent
+    from the frame are unchanged since ``base_rev``."""
+    delta = snap.delta
+    meta = {k: getattr(snap, k) for k in _SNAP_META}
+    meta["resource_names"] = list(snap.resource_names)
+    meta["cache_key"] = snap.cache_key
+    meta["rev"] = snap.rev
+    meta["base_rev"] = delta.base_rev
+    arrays: Dict[str, np.ndarray] = {}
+    for name in _SNAP_ARRAYS:
+        if name not in delta.planes:
+            continue
+        arr = getattr(snap, name)
+        rows = delta.planes[name]
+        if rows is None:
+            arrays["full__" + name] = arr
+        elif rows.size:
+            arrays["idx__" + name] = rows.astype(np.int64)
+            arrays["row__" + name] = np.ascontiguousarray(arr[rows])
+    return _pack_arrays(meta, arrays)
+
+
+def apply_delta(base_snap, meta: Dict, arrays: Dict[str, np.ndarray]):
+    """Server-side inverse of serialize_delta: a NEW snapshot sharing
+    unchanged planes with ``base_snap`` (never mutated in place, so the
+    stored base stays valid if the kernel later fails)."""
+    snap = _snapshot_from(meta, {})
+    for name in _SNAP_ARRAYS:
+        full = arrays.get("full__" + name)
+        if full is not None:
+            setattr(snap, name, full)
+            continue
+        arr = getattr(base_snap, name)
+        idx = arrays.get("idx__" + name)
+        if idx is not None:
+            arr = arr.copy()
+            arr[idx] = arrays["row__" + name]
+        setattr(snap, name, arr)
     return snap
 
 
@@ -143,7 +201,7 @@ def deserialize_preempt(payload: bytes):
     from volcano_tpu.ops.preempt_pack import PreemptPacked
 
     (blen,) = struct.unpack_from("<I", payload, 0)
-    base = deserialize_snapshot(payload[4 : 4 + blen])
+    base, _ = deserialize_snapshot(payload[4 : 4 + blen])
     meta, arrays = _unpack_arrays(payload[4 + blen :])
     pk = PreemptPacked(base=base)
     for k in _PK_META:
@@ -188,6 +246,32 @@ def _recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
     return mtype, _recv_exact(sock, length)
 
 
+class _SessionStore:
+    """Server-held snapshots keyed by the client's PackCache identity, so
+    steady-state warm sessions ship delta frames instead of full
+    snapshots.  Small LRU — one live scheduler per key, a handful of
+    keys per sidecar."""
+
+    def __init__(self, max_entries: int = 4):
+        self._lock = threading.Lock()
+        self._max = max_entries
+        self._entries: "Dict[str, Tuple[int, object]]" = {}
+
+    def put(self, key: str, rev: int, snap) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+            if len(self._entries) >= self._max:
+                self._entries.pop(next(iter(self._entries)))
+            self._entries[key] = (rev, snap)
+
+    def get(self, key: str):
+        with self._lock:
+            return self._entries.get(key)
+
+
+_session_store = _SessionStore()
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):  # one connection, many requests
         while True:
@@ -204,8 +288,29 @@ class _Handler(socketserver.BaseRequestHandler):
                 elif mtype == T_ALLOC_REQ:
                     from volcano_tpu.ops.dispatch import run_packed_auto
 
-                    snap = deserialize_snapshot(payload)
+                    snap, meta = deserialize_snapshot(payload)
                     assignment = run_packed_auto(snap)
+                    if meta.get("cache_key"):
+                        _session_store.put(
+                            meta["cache_key"], int(meta["rev"]), snap
+                        )
+                    _send_frame(
+                        self.request, T_ALLOC_RESP,
+                        _pack_arrays({}, {"assignment": assignment}),
+                    )
+                elif mtype == T_ALLOC_DELTA_REQ:
+                    from volcano_tpu.ops.dispatch import run_packed_auto
+
+                    meta, arrays = _unpack_arrays(payload)
+                    held = _session_store.get(meta["cache_key"])
+                    if held is None or held[0] != int(meta["base_rev"]):
+                        _send_frame(self.request, T_NEED_FULL, b"")
+                        continue
+                    snap = apply_delta(held[1], meta, arrays)
+                    assignment = run_packed_auto(snap)
+                    _session_store.put(
+                        meta["cache_key"], int(meta["rev"]), snap
+                    )
                     _send_frame(
                         self.request, T_ALLOC_RESP,
                         _pack_arrays({}, {"assignment": assignment}),
@@ -277,6 +382,13 @@ class ComputePlaneClient:
         self.timeout = timeout
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        #: session revision the SERVER is known to hold, per cache_key —
+        #: a delta frame is only worth sending when the server's copy is
+        #: exactly the delta's base revision
+        self._acked: Dict[str, int] = {}
+        #: set after an "unknown type" error — an old sidecar; stop
+        #: attempting delta frames until reconnect
+        self._delta_unsupported = False
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
@@ -304,9 +416,33 @@ class ComputePlaneClient:
             return False
 
     def allocate(self, snap) -> np.ndarray:
+        key = getattr(snap, "cache_key", None)
+        if (
+            key
+            and snap.delta is not None
+            and not self._delta_unsupported
+            and self._acked.get(key) == snap.delta.base_rev
+        ):
+            mtype, payload = self._roundtrip(
+                T_ALLOC_DELTA_REQ, serialize_delta(snap)
+            )
+            if mtype == T_ALLOC_RESP:
+                self._acked[key] = snap.rev
+                _, arrays = _unpack_arrays(payload)
+                return arrays["assignment"]
+            if mtype == T_ERROR:
+                msg = payload.decode()
+                if "unknown type" not in msg:
+                    raise RuntimeError(f"compute plane: {msg}")
+                # pre-delta sidecar: remember and fall through to full
+                self._delta_unsupported = True
+                log.info("compute plane %s has no delta support", self.socket_path)
+            # T_NEED_FULL (or unsupported) → full frame below re-seeds
         mtype, payload = self._roundtrip(T_ALLOC_REQ, serialize_snapshot(snap))
         if mtype == T_ERROR:
             raise RuntimeError(f"compute plane: {payload.decode()}")
+        if key:
+            self._acked[key] = snap.rev
         _, arrays = _unpack_arrays(payload)
         return arrays["assignment"]
 
@@ -323,3 +459,6 @@ class ComputePlaneClient:
                 self._sock.close()
             finally:
                 self._sock = None
+                # the next connection may reach a restarted (upgraded)
+                # sidecar — re-probe delta support
+                self._delta_unsupported = False
