@@ -21,7 +21,7 @@ def base_job(**spec_kw):
             batch.TaskSpec(
                 name="worker",
                 replicas=1,
-                template=core.PodTemplateSpec(spec=core.PodSpec(containers=[core.Container()])),
+                template=core.PodTemplateSpec(spec=core.PodSpec(containers=[core.Container(image="busybox")])),
             )
         ],
     )
@@ -238,7 +238,7 @@ def _job_with_template(container=None, restart_policy="OnFailure"):
                     replicas=1,
                     template=core.PodTemplateSpec(
                         spec=core.PodSpec(
-                            containers=[container or core.Container()],
+                            containers=[container or core.Container(image="busybox")],
                             restart_policy=restart_policy,
                         )
                     ),
@@ -286,6 +286,7 @@ class TestValidateTaskTemplate:
         validate_job(
             _job_with_template(
                 core.Container(
+                    image="busybox",
                     resources={
                         "requests": {"cpu": "500m", "memory": "1Gi"},
                         "limits": {"cpu": "1", "memory": "2Gi"},
@@ -297,6 +298,21 @@ class TestValidateTaskTemplate:
     def test_bad_restart_policy_denied(self):
         job = _job_with_template(restart_policy="WheneverConvenient")
         with pytest.raises(AdmissionError, match="restartPolicy"):
+            validate_job(job)
+
+    def test_missing_image_denied(self):
+        """k8s ValidateContainers: image is required — an imageless
+        template previously failed only at pod-creation time, far from
+        the submitter (admit_job.go:194+)."""
+        job = _job_with_template(core.Container(name="main"))
+        with pytest.raises(AdmissionError, match="image: required"):
+            validate_job(job)
+        # init containers are held to the same requirement
+        job = _job_with_template()
+        job.spec.tasks[0].template.spec.init_containers = [
+            core.Container(name="init")
+        ]
+        with pytest.raises(AdmissionError, match="initContainers.*image: required"):
             validate_job(job)
 
     def test_port_out_of_range_denied(self):
@@ -352,8 +368,10 @@ class TestValidateTaskTemplate:
         only duplicates within one container are denied."""
         job = _job_with_template()
         job.spec.tasks[0].template.spec.containers = [
-            core.Container(name="app", ports=[core.ContainerPort(container_port=8080)]),
-            core.Container(name="metrics", ports=[core.ContainerPort(container_port=8080)]),
+            core.Container(name="app", image="busybox",
+                           ports=[core.ContainerPort(container_port=8080)]),
+            core.Container(name="metrics", image="busybox",
+                           ports=[core.ContainerPort(container_port=8080)]),
         ]
         validate_job(job)
 
@@ -373,7 +391,8 @@ class TestValidateTemplateIdentity:
         # k8s validation.ValidateEnv admits duplicates (last entry wins
         # at runtime); the subset must not deny what the reference admits
         job = _job_with_template(
-            core.Container(env=[core.EnvVar(name="A", value="1"),
+            core.Container(image="busybox",
+                           env=[core.EnvVar(name="A", value="1"),
                                 core.EnvVar(name="A", value="2")])
         )
         validate_job(job)
@@ -388,7 +407,7 @@ class TestValidateTemplateIdentity:
 
     def test_mount_with_declared_volume_allowed(self):
         job = _job_with_template(
-            core.Container(volume_mounts=[
+            core.Container(image="busybox", volume_mounts=[
                 core.VolumeMount(name="data", mount_path="/data")])
         )
         job.spec.tasks[0].template.spec.volumes = [
@@ -427,7 +446,8 @@ class TestValidateTemplateIdentity:
 
     def test_valid_identity_fields_allowed(self):
         job = _job_with_template(
-            core.Container(env=[core.EnvVar(name="VC_TASK_INDEX", value="0")])
+            core.Container(image="busybox",
+                           env=[core.EnvVar(name="VC_TASK_INDEX", value="0")])
         )
         spec = job.spec.tasks[0].template.spec
         spec.hostname = "worker-0"

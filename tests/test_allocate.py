@@ -148,3 +148,132 @@ def test_taints_respected():
         cache, [AllocateAction()], tiers(["gang"], ["drf", "predicates", "proportion"])
     )
     assert cache.binder.binds == {"c1/p1": "n2"}
+
+
+class TestNodeSubsampling:
+    """Host-fallback node subsampling (options.go:38-40 +
+    scheduler_helper.go:42-61): the allocate host predicate loop stops
+    scanning once the feasible-node budget is met, so the no-TPU path
+    copes with large node counts.  Wired from the vtpu-scheduler flags
+    --percentage-nodes-to-find / --minimum-feasible-nodes."""
+
+    def _with_opts(self, **kw):
+        from volcano_tpu.scheduler import util as sched_util
+
+        saved = sched_util.server_opts
+        sched_util.server_opts = sched_util.ServerOpts(**kw)
+        return saved
+
+    def _restore(self, saved):
+        from volcano_tpu.scheduler import util as sched_util
+
+        sched_util.server_opts = saved
+
+    def test_budget_formula_matches_reference(self):
+        from volcano_tpu.scheduler.util import (
+            calculate_num_of_feasible_nodes_to_find,
+        )
+
+        saved = self._with_opts(min_nodes_to_find=100,
+                                min_percentage_of_nodes_to_find=5,
+                                percentage_of_nodes_to_find=100)
+        try:
+            # percentage 100 → scan everything regardless of size
+            assert calculate_num_of_feasible_nodes_to_find(5000) == 5000
+        finally:
+            self._restore(saved)
+        saved = self._with_opts(min_nodes_to_find=100,
+                                min_percentage_of_nodes_to_find=5,
+                                percentage_of_nodes_to_find=10)
+        try:
+            # small clusters never subsample; large ones take the
+            # percentage with the absolute floor
+            assert calculate_num_of_feasible_nodes_to_find(50) == 50
+            assert calculate_num_of_feasible_nodes_to_find(5000) == 500
+            assert calculate_num_of_feasible_nodes_to_find(600) == 100
+        finally:
+            self._restore(saved)
+        saved = self._with_opts(min_nodes_to_find=100,
+                                min_percentage_of_nodes_to_find=5,
+                                percentage_of_nodes_to_find=0)
+        try:
+            # adaptive mode: 50 - n/125, floored at the min percentage
+            # (scheduler_helper.go:50-55)
+            assert calculate_num_of_feasible_nodes_to_find(1000) == 420
+            assert calculate_num_of_feasible_nodes_to_find(6000) == 300
+        finally:
+            self._restore(saved)
+
+    def test_predicate_loop_honors_budget(self):
+        """predicate_nodes stops after finding the budgeted number of
+        feasible nodes — the scan visits a strict subset."""
+        from volcano_tpu.scheduler.util import predicate_nodes
+
+        nodes = [build_node(f"n{i:04d}", {"cpu": "8", "memory": "16Gi"})
+                 for i in range(200)]
+        from volcano_tpu.api import Resource, TaskInfo
+        task = TaskInfo(uid="t1", job="j1", name="p", namespace="ns",
+                        resreq=Resource.from_resource_list({"cpu": "1"}))
+
+        visited = []
+
+        def fn(t, n):
+            visited.append(n.name)
+
+        from volcano_tpu.api import NodeInfo
+        node_infos = [NodeInfo(n) for n in nodes]
+
+        saved = self._with_opts(min_nodes_to_find=10,
+                                min_percentage_of_nodes_to_find=5,
+                                percentage_of_nodes_to_find=10)
+        try:
+            found, _ = predicate_nodes(task, node_infos, fn)
+            # budget = max(200*10//100, 10) = 20 of 200 nodes
+            assert len(found) == 20
+            assert len(visited) == 20
+        finally:
+            self._restore(saved)
+
+    def test_allocate_still_binds_under_subsampling(self):
+        """End to end through the host allocate action: with an
+        aggressive budget the gang still binds (fewer nodes scanned,
+        same correctness)."""
+        saved = self._with_opts(min_nodes_to_find=2,
+                                min_percentage_of_nodes_to_find=1,
+                                percentage_of_nodes_to_find=1)
+        try:
+            cache = make_cache(
+                nodes=[build_node(f"n{i}", {"cpu": "2", "memory": "4G"})
+                       for i in range(50)],
+                pods=[
+                    build_pod("c1", "p1", "", {"cpu": "1", "memory": "1G"}, group="pg1"),
+                    build_pod("c1", "p2", "", {"cpu": "1", "memory": "1G"}, group="pg1"),
+                ],
+                pod_groups=[build_pod_group("c1", "pg1", 2, queue="c1")],
+                queues=[build_queue("c1")],
+            )
+            run_actions(cache, [AllocateAction()],
+                        tiers(["gang"], ["drf", "predicates", "proportion"]))
+            assert len(cache.binder.binds) == 2
+        finally:
+            self._restore(saved)
+
+    def test_scheduler_flags_set_server_opts(self):
+        """vtpu-scheduler --percentage-nodes-to-find /
+        --minimum-feasible-nodes land in scheduler.util.server_opts."""
+        import argparse
+
+        from volcano_tpu.cmd.scheduler import add_common_args
+
+        # replicate the main() parser wiring without starting the daemon
+        parser = argparse.ArgumentParser()
+        parser.add_argument("--percentage-nodes-to-find", type=int, default=100)
+        parser.add_argument("--minimum-feasible-nodes", type=int, default=100)
+        parser.add_argument("--minimum-percentage-nodes-to-find", type=int, default=5)
+        add_common_args(parser)
+        args = parser.parse_args([
+            "--percentage-nodes-to-find", "10",
+            "--minimum-feasible-nodes", "50",
+        ])
+        assert args.percentage_nodes_to_find == 10
+        assert args.minimum_feasible_nodes == 50

@@ -2,8 +2,11 @@
 
 Reference: installer/helm/chart/volcano/values.yaml + templates/ — the
 chart parametrizes image names/tags, pull secret, and the scheduler
-policy file; these tests pin the same parametrization surface on the
-renderer in volcano_tpu/deploy/package.py.
+policy file, and stamps out one Deployment per daemon; these tests pin
+the same parametrization surface on the renderer in
+volcano_tpu/deploy/package.py, now rendering the multi-process bus
+topology (vtpu-apiserver + scheduler + controllers + admission, all
+wired with --bus).
 """
 
 import yaml
@@ -17,32 +20,92 @@ from volcano_tpu.deploy.package import (
     render_yaml,
 )
 
+BUS_URL = "tcp://volcano-tpu-apiserver.volcano-tpu-system.svc:7180"
 
-def _by_kind(manifests):
-    return {m["kind"]: m for _, m in manifests}
+
+def _by_name(manifests):
+    return {m["metadata"]["name"] + "/" + m["kind"]: m for _, m in manifests}
+
+
+def _deployment(manifests, daemon):
+    return _by_name(manifests)[f"volcano-tpu-{daemon}/Deployment"]
+
+
+def _container(manifests, daemon, name=None):
+    containers = _deployment(manifests, daemon)["spec"]["template"]["spec"]["containers"]
+    if name is None:
+        return containers[0]
+    return next(c for c in containers if c["name"] == name)
 
 
 def test_default_render_manifest_set():
     manifests = render(DEFAULT_VALUES)
     names = [fname for fname, _ in manifests]
-    assert names == ["00-namespace.yaml", "10-scheduler-configmap.yaml",
-                     "20-deployment.yaml", "30-service.yaml"]
-    # kubectl apply -f DIR walks lexically; apply order must survive it
+    assert names == [
+        "00-namespace.yaml", "10-scheduler-configmap.yaml",
+        "20-apiserver-deployment.yaml", "21-apiserver-service.yaml",
+        "30-scheduler-deployment.yaml", "31-controllers-deployment.yaml",
+        "32-admission-deployment.yaml",
+    ]
+    # kubectl apply -f DIR walks lexically; apply order must survive it:
+    # namespace first, then the apiserver before the daemons dialing it
     assert names == sorted(names)
-    kinds = _by_kind(manifests)
-    assert kinds["Namespace"]["metadata"]["name"] == "volcano-tpu-system"
-    dep = kinds["Deployment"]
-    assert dep["metadata"]["namespace"] == "volcano-tpu-system"
-    containers = dep["spec"]["template"]["spec"]["containers"]
-    assert [c["name"] for c in containers] == ["control-plane", "compute-plane"]
+    by_name = _by_name(manifests)
+    assert by_name["volcano-tpu-system/Namespace"]
+    for daemon in ("apiserver", "scheduler", "controllers", "admission"):
+        dep = _deployment(manifests, daemon)
+        assert dep["metadata"]["namespace"] == "volcano-tpu-system"
     # every manifest round-trips through YAML
     for _, m in manifests:
         assert yaml.safe_load(yaml.safe_dump(m)) == m
 
 
+def test_every_daemon_dials_the_bus():
+    """The topology claim: one apiserver serving the bus; scheduler,
+    controllers, and admission all wired to it with --bus."""
+    manifests = render(DEFAULT_VALUES)
+    api = _container(manifests, "apiserver")
+    assert api["command"][0] == "vtpu-apiserver"
+    assert api["command"][api["command"].index("--port") + 1] == "7180"
+    svc = _by_name(manifests)["volcano-tpu-apiserver/Service"]
+    assert {"name": "bus", "port": 7180} in svc["spec"]["ports"]
+
+    for daemon, binary in (("scheduler", "vtpu-scheduler"),
+                           ("controllers", "vtpu-controllers"),
+                           ("admission", "vtpu-admission")):
+        cmd = _container(manifests, daemon)["command"]
+        assert cmd[0] == binary
+        assert cmd[cmd.index("--bus") + 1] == BUS_URL
+
+
+def test_ha_replicas_get_leader_election():
+    # controllers default to 2 leader-elected replicas (no accelerator
+    # demand, HA is free); the scheduler defaults to 1 because every
+    # scheduler pod holds a full TPU slice — a default standby would sit
+    # Pending on a single-slice cluster
+    manifests = render(DEFAULT_VALUES)
+    dep = _deployment(manifests, "controllers")
+    assert dep["spec"]["replicas"] == 2
+    assert "--leader-elect" in _container(manifests, "controllers")["command"]
+    assert _deployment(manifests, "scheduler")["spec"]["replicas"] == 1
+    assert "--leader-elect" not in _container(manifests, "scheduler")["command"]
+    # opting into scheduler HA (spare slices exist) wires the lease
+    values = merge_values(DEFAULT_VALUES, {"scheduler": {"replicas": 2}})
+    manifests = render(values)
+    assert _deployment(manifests, "scheduler")["spec"]["replicas"] == 2
+    assert "--leader-elect" in _container(manifests, "scheduler")["command"]
+
+
+def test_apiserver_seeds_synthetic_nodes():
+    manifests = render(DEFAULT_VALUES)
+    cmd = _container(manifests, "apiserver")["command"]
+    assert cmd[cmd.index("--seed-nodes") + 1] == "8"
+
+
 def test_configmap_inlines_default_scheduler_conf():
-    kinds = _by_kind(render(DEFAULT_VALUES))
-    conf_text = kinds["ConfigMap"]["data"]["volcano-scheduler.conf"]
+    manifests = render(DEFAULT_VALUES)
+    cm = _by_name(manifests)["volcano-tpu-scheduler-configmap/ConfigMap"]
+    conf_text = cm["data"]["volcano-scheduler.conf"]
     parsed = yaml.safe_load(conf_text)
     assert "allocate" in parsed["actions"]
     assert parsed["tiers"]
@@ -53,36 +116,37 @@ def test_configmap_inlines_custom_conf_file(tmp_path):
     conf.write_text("actions: \"enqueue, allocate\"\ntiers: []\n")
     values = merge_values(
         DEFAULT_VALUES, {"basic": {"scheduler_config_file": str(conf)}})
-    kinds = _by_kind(render(values))
-    assert kinds["ConfigMap"]["data"]["volcano-scheduler.conf"] == conf.read_text()
+    manifests = render(values)
+    cm = _by_name(manifests)["volcano-tpu-scheduler-configmap/ConfigMap"]
+    assert cm["data"]["volcano-scheduler.conf"] == conf.read_text()
 
 
 def test_compute_plane_sidecar_wiring():
-    kinds = _by_kind(render(DEFAULT_VALUES))
-    spec = kinds["Deployment"]["spec"]["template"]["spec"]
-    cp, sidecar = spec["containers"]
+    manifests = render(DEFAULT_VALUES)
+    spec = _deployment(manifests, "scheduler")["spec"]["template"]["spec"]
+    sched, sidecar = spec["containers"]
     socket = "/run/vtpu/compute-plane.sock"
-    # control plane points at the socket; sidecar serves it; both mount
+    # the scheduler points at the socket; sidecar serves it; both mount
     # the shared emptyDir volume
-    assert {"name": "VTPU_COMPUTE_PLANE", "value": socket} in cp["env"]
+    assert {"name": "VTPU_COMPUTE_PLANE", "value": socket} in sched["env"]
     assert sidecar["command"][:3] == ["vtpu-compute-plane", "--socket", socket]
     assert "--warmup" in sidecar["command"]
     assert sidecar["resources"]["limits"]["google.com/tpu"] == "8"
     mounts = {v["name"] for v in spec["volumes"]}
     assert "compute-plane-socket" in mounts
-    for c in (cp, sidecar):
+    for c in (sched, sidecar):
         assert any(m["name"] == "compute-plane-socket" for m in c["volumeMounts"])
 
 
 def test_compute_plane_disabled():
     values = merge_values(DEFAULT_VALUES, {"compute_plane": {"enabled": False}})
-    kinds = _by_kind(render(values))
-    spec = kinds["Deployment"]["spec"]["template"]["spec"]
-    assert [c["name"] for c in spec["containers"]] == ["control-plane"]
+    manifests = render(values)
+    spec = _deployment(manifests, "scheduler")["spec"]["template"]["spec"]
+    assert [c["name"] for c in spec["containers"]] == ["scheduler"]
     assert "env" not in spec["containers"][0]
     assert all(v["name"] != "compute-plane-socket" for v in spec["volumes"])
     # in-process kernels still need the device: the TPU limit moves onto
-    # the control-plane container instead of vanishing with the sidecar
+    # the scheduler container instead of vanishing with the sidecar
     assert spec["containers"][0]["resources"]["limits"]["google.com/tpu"] == "8"
 
 
@@ -104,32 +168,41 @@ def test_values_file_merge_and_image_pull_secret():
     }))
     # untouched defaults survive the merge
     assert values["scheduler"]["port"] == 8080
-    kinds = _by_kind(render(values))
-    dep = kinds["Deployment"]
-    assert dep["metadata"]["name"] == "vt-prod"
+    manifests = render(values)
+    by_name = _by_name(manifests)
+    dep = by_name["vt-prod-scheduler/Deployment"]
     spec = dep["spec"]["template"]["spec"]
     assert spec["containers"][0]["image"] == "volcano-tpu:v1.2.3"
-    assert spec["imagePullSecrets"] == [{"name": "regcred"}]
-    assert kinds["Service"]["metadata"]["namespace"] == "prod"
+    cmd = spec["containers"][0]["command"]
+    assert cmd[cmd.index("--bus") + 1] == "tcp://vt-prod-apiserver.prod.svc:7180"
+    # every daemon pod can pull from the private registry
+    for daemon in ("apiserver", "scheduler", "controllers", "admission"):
+        d = by_name[f"vt-prod-{daemon}/Deployment"]
+        assert d["spec"]["template"]["spec"]["imagePullSecrets"] == [
+            {"name": "regcred"}]
+    assert by_name["vt-prod-apiserver/Service"]["metadata"]["namespace"] == "prod"
 
 
 def test_set_overrides_with_coercion():
     values = DEFAULT_VALUES
     for assignment in ("scheduler.port=9090",
-                      "prometheus.scrape=false",
-                      "compute_plane.tpu_chips=4",
-                      "basic.image_tag_version=nightly"):
+                       "bus.port=7777",
+                       "prometheus.scrape=false",
+                       "compute_plane.tpu_chips=4",
+                       "basic.image_tag_version=nightly"):
         values = apply_set(values, assignment)
     assert values["scheduler"]["port"] == 9090
     assert values["prometheus"]["scrape"] is False
-    kinds = _by_kind(render(values))
-    dep = kinds["Deployment"]
-    meta = dep["spec"]["template"]["metadata"]
+    manifests = render(values)
+    sched = _container(manifests, "scheduler")
+    meta = _deployment(manifests, "scheduler")["spec"]["template"]["metadata"]
     assert "annotations" not in meta
-    spec = dep["spec"]["template"]["spec"]
-    assert spec["containers"][0]["image"] == "volcano-tpu:nightly"
-    assert spec["containers"][1]["resources"]["limits"]["google.com/tpu"] == "4"
-    assert {"containerPort": 9090, "name": "scheduler"} in spec["containers"][0]["ports"]
+    assert sched["image"] == "volcano-tpu:nightly"
+    assert sched["livenessProbe"]["httpGet"]["port"] == 9090
+    cmd = sched["command"]
+    assert cmd[cmd.index("--bus") + 1].endswith(":7777")
+    sidecar = _container(manifests, "scheduler", "compute-plane")
+    assert sidecar["resources"]["limits"]["google.com/tpu"] == "4"
 
 
 def test_set_rejects_malformed():
@@ -165,15 +238,26 @@ def test_set_string_skips_coercion():
     assert image == "volcano-tpu:20260730"
 
 
-def test_deployment_recreate_strategy():
-    kinds = _by_kind(render(DEFAULT_VALUES))
-    assert kinds["Deployment"]["spec"]["strategy"] == {"type": "Recreate"}
+def test_deployment_rollout_strategies():
+    # Recreate only where forced: apiserver (two concurrent stores
+    # behind one Service would split clients between divergent stores)
+    # and scheduler (a surge pod can't place while the old pod holds
+    # the TPU chips).  Controllers/admission roll normally — Recreate
+    # there would guarantee a full outage on every image upgrade.
+    manifests = render(DEFAULT_VALUES)
+    for daemon in ("apiserver", "scheduler"):
+        dep = _deployment(manifests, daemon)
+        assert dep["spec"]["strategy"] == {"type": "Recreate"}
+    for daemon in ("controllers", "admission"):
+        dep = _deployment(manifests, daemon)
+        assert dep["spec"]["strategy"] == {"type": "RollingUpdate"}
 
 
 def test_render_yaml_stream_parses():
     docs = list(yaml.safe_load_all(render_yaml(DEFAULT_VALUES)))
     assert [d["kind"] for d in docs] == [
-        "Namespace", "ConfigMap", "Deployment", "Service"]
+        "Namespace", "ConfigMap", "Deployment", "Service",
+        "Deployment", "Deployment", "Deployment"]
 
 
 def test_empty_section_header_keeps_defaults():
@@ -185,24 +269,68 @@ def test_empty_section_header_keeps_defaults():
     render(values)
 
 
-def test_static_manifest_command_parses():
+def test_static_manifest_commands_parse():
     # the hand-written deploy/kubernetes manifest must stay parseable by
-    # the real vtpu-local-up parser (a flag rename would otherwise ship
-    # a CrashLooping pod while all renderer tests stay green)
+    # the real daemon argument parsers (a flag rename would otherwise
+    # ship CrashLooping pods while all renderer tests stay green)
     import os
-
-    from volcano_tpu.cmd.local_up import build_parser
 
     path = os.path.join(os.path.dirname(__file__), "..",
                         "deploy", "kubernetes", "volcano-tpu.yaml")
     with open(path, "r", encoding="utf-8") as fh:
         docs = [d for d in yaml.safe_load_all(fh) if d]
-    dep = next(d for d in docs if d["kind"] == "Deployment")
-    cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
-    assert cmd[0] == "vtpu-local-up"
-    args = build_parser().parse_args(cmd[1:])
-    assert args.serve is True
-    assert args.listen_host == "0.0.0.0"
+    deployments = [d for d in docs if d["kind"] == "Deployment"]
+    assert len(deployments) == 4
+    seen = set()
+    for dep in deployments:
+        for c in dep["spec"]["template"]["spec"]["containers"]:
+            binary = c["command"][0]
+            seen.add(binary)
+            if binary == "vtpu-apiserver":
+                known = {"--listen-host", "--port", "--listen-port",
+                         "--backlog-size", "--bookmark-interval",
+                         "--enable-debug-stacks", "--seed-nodes",
+                         "--seed-node-cpu", "--seed-node-mem"}
+            elif binary == "vtpu-scheduler":
+                known = {"--bus", "--listen-host", "--listen-port",
+                         "--leader-elect", "--leader-elect-id",
+                         "--scheduler-conf", "--schedule-period",
+                         "--scheduler-name", "--gc-quiesce-period",
+                         "--snapshot-reuse", "--warmup",
+                         "--percentage-nodes-to-find",
+                         "--minimum-feasible-nodes",
+                         "--minimum-percentage-nodes-to-find",
+                         "--enable-debug-stacks"}
+            elif binary == "vtpu-controllers":
+                known = {"--bus", "--listen-host", "--listen-port",
+                         "--leader-elect", "--leader-elect-id", "--period",
+                         "--enable-debug-stacks"}
+            elif binary == "vtpu-admission":
+                known = {"--bus", "--listen-host", "--listen-port",
+                         "--leader-elect", "--leader-elect-id",
+                         "--gate-pods", "--enable-debug-stacks"}
+            elif binary == "vtpu-compute-plane":
+                continue
+            else:
+                raise AssertionError(f"unexpected binary {binary}")
+            flags = {a for a in c["command"][1:] if a.startswith("--")}
+            assert flags <= known, (binary, flags - known)
+    assert {"vtpu-apiserver", "vtpu-scheduler", "vtpu-controllers",
+            "vtpu-admission"} <= seen
+
+
+def test_static_manifest_matches_renderer():
+    # deploy/kubernetes/volcano-tpu.yaml IS the rendered default output
+    # (plus the header comment) — regenerate it when values change:
+    #   python -m volcano_tpu.cmd.package template > ...
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "deploy", "kubernetes", "volcano-tpu.yaml")
+    with open(path, "r", encoding="utf-8") as fh:
+        static = [d for d in yaml.safe_load_all(fh) if d]
+    rendered = [m for _, m in render(DEFAULT_VALUES)]
+    assert static == rendered
 
 
 def test_chart_values_file_matches_defaults():
@@ -216,83 +344,33 @@ def test_chart_values_file_matches_defaults():
         assert load_values(fh.read()) == DEFAULT_VALUES
 
 
-def test_rendered_command_parses_and_serves():
-    # the Deployment command must be accepted verbatim by the real
-    # vtpu-local-up argument parser and carry serve mode + the mounted
-    # conf + the same ports the probe/Service/annotations point at
-    from volcano_tpu.cmd.local_up import build_parser
+def test_rendered_scheduler_command_parses():
+    # the scheduler Deployment command must be accepted verbatim by the
+    # real vtpu-scheduler argument parser and carry the mounted conf +
+    # the same port the probe points at
+    import argparse
 
-    kinds = _by_kind(render(DEFAULT_VALUES))
-    container = kinds["Deployment"]["spec"]["template"]["spec"]["containers"][0]
+    from volcano_tpu.cmd.scheduler import add_common_args
+
+    manifests = render(
+        merge_values(DEFAULT_VALUES, {"scheduler": {"replicas": 2}}))
+    container = _container(manifests, "scheduler")
     cmd = container["command"]
-    assert cmd[0] == "vtpu-local-up"
+    assert cmd[0] == "vtpu-scheduler"
 
-    args = build_parser().parse_args(cmd[1:])
-    assert args.serve is True
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--scheduler-conf", default="")
+    parser.add_argument("--schedule-period", type=float, default=1.0)
+    add_common_args(parser)
+    args = parser.parse_args(cmd[1:])
+    assert args.bus == BUS_URL
     assert args.listen_host == "0.0.0.0"
-    assert args.scheduler_port == 8080
+    assert args.listen_port == 8080
+    assert args.leader_elect is True
     assert args.scheduler_conf == "/etc/volcano-tpu/volcano-scheduler.conf"
     # the conf path the command reads is inside the ConfigMap mount
     mount = next(m for m in container["volumeMounts"]
                  if m["name"] == "scheduler-config")
     assert args.scheduler_conf.startswith(mount["mountPath"] + "/")
     # probe port agrees with the port the process actually binds
-    probe = container["livenessProbe"]["httpGet"]["port"]
-    assert probe == args.scheduler_port
-
-
-def test_local_up_fixed_ports_and_conf(tmp_path):
-    # local_up() must honor fixed ports (probes depend on them) and
-    # thread the conf path into the scheduler's hot-reload loop
-    import socket
-    import urllib.request
-
-    from volcano_tpu.cmd.local_up import local_up
-
-    # a genuinely fixed port (probes depend on the kwarg being honored;
-    # port 0 would pass even if the kwarg were dropped)
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        fixed_port = s.getsockname()[1]
-
-    conf = tmp_path / "policy.yaml"
-    conf.write_text("actions: \"enqueue, allocate\"\ntiers: []\n")
-    api, daemons = local_up(
-        nodes=1, scheduler_conf=str(conf),
-        admission_port=0, controllers_port=0, scheduler_port=fixed_port,
-    )
-    try:
-        admission, controllers, scheduler = daemons
-        assert scheduler.scheduler.scheduler_conf_path == str(conf)
-        assert scheduler.serving.port == fixed_port
-        # every daemon's /healthz answers on its reported port
-        for d in daemons:
-            with urllib.request.urlopen(
-                    f"http://127.0.0.1:{d.serving.port}/healthz", timeout=5) as r:
-                assert r.status == 200
-    finally:
-        for d in daemons:
-            d.stop()
-
-
-def test_cli_render_and_template(tmp_path, capsys):
-    from volcano_tpu.cmd.package import main
-
-    out = tmp_path / "out"
-    rc = main(["render", "-o", str(out), "--set", "basic.namespace=ns2"])
-    assert rc == 0
-    files = sorted(p.name for p in out.iterdir())
-    assert files == ["00-namespace.yaml", "10-scheduler-configmap.yaml",
-                     "20-deployment.yaml", "30-service.yaml"]
-    dep = yaml.safe_load((out / "20-deployment.yaml").read_text())
-    assert dep["metadata"]["namespace"] == "ns2"
-    capsys.readouterr()
-
-    rc = main(["template"])
-    assert rc == 0
-    docs = list(yaml.safe_load_all(capsys.readouterr().out))
-    assert len(docs) == 4
-
-    rc = main(["values"])
-    assert rc == 0
-    assert yaml.safe_load(capsys.readouterr().out) == DEFAULT_VALUES
+    assert container["livenessProbe"]["httpGet"]["port"] == args.listen_port

@@ -100,7 +100,9 @@ def submit(cluster: Cluster, name="e2e-job", replicas=3, min_available=3, **spec
         replicas=replicas,
         template=core.PodTemplateSpec(
             spec=core.PodSpec(
-                containers=[core.Container(resources={"requests": {"cpu": "1", "memory": "1Gi"}})]
+                containers=[core.Container(
+                    image="registry.k8s.io/pause:3.9",
+                    resources={"requests": {"cpu": "1", "memory": "1Gi"}})]
             )
         ),
     )
@@ -569,6 +571,7 @@ class TestE2EErrorHandlingMatrix:
             template=core.PodTemplateSpec(
                 spec=core.PodSpec(
                     containers=[core.Container(
+                        image="registry.k8s.io/pause:3.9",
                         resources={"requests": {"cpu": "1", "memory": "1Gi"}})]
                 )
             ),
@@ -608,6 +611,7 @@ class TestE2EDistributedWorkloads:
                     spec=core.PodSpec(
                         containers=[core.Container(
                             name="main",
+                            image="registry.k8s.io/pause:3.9",
                             command=cmd,
                             resources={"requests": {"cpu": "1", "memory": "1Gi"}},
                         )]
@@ -683,12 +687,14 @@ class TestE2EDistributedWorkloads:
                         name="ps", replicas=2,
                         template=core.PodTemplateSpec(spec=core.PodSpec(
                             containers=[core.Container(
+                                image="registry.k8s.io/pause:3.9",
                                 resources={"requests": {"cpu": "1", "memory": "1Gi"}})])),
                     ),
                     batch.TaskSpec(
                         name="worker", replicas=2,
                         template=core.PodTemplateSpec(spec=core.PodSpec(
                             containers=[core.Container(
+                                image="registry.k8s.io/pause:3.9",
                                 resources={"requests": {"cpu": "1", "memory": "1Gi"}})])),
                     ),
                 ],

@@ -45,7 +45,9 @@ def _submit(vc, name="srv-job", replicas=2):
         template=core.PodTemplateSpec(
             spec=core.PodSpec(
                 containers=[
-                    core.Container(resources={"requests": {"cpu": "1", "memory": "1Gi"}})
+                    core.Container(
+                        image="registry.k8s.io/pause:3.9",
+                        resources={"requests": {"cpu": "1", "memory": "1Gi"}})
                 ]
             )
         ),

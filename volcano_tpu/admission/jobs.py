@@ -5,14 +5,15 @@ jobs/validate/admit_job.go:103-258.
 
 Validation SUBSET note: this module checks job/task naming (DNS-1123),
 replica/minAvailable arithmetic, duplicate task names, policy event/
-action legality (incl. exclusiveness rules), resource quantity syntax
-and requests≤limits, restart policy, port legality, env-var names,
-volume-mount/volume cross-references, and pod volume/hostname/subdomain
-identity.  The reference runs the complete vendored k8s PodTemplateSpec
-validators (admit_job.go:194+ → k8s validation.ValidatePodTemplateSpec);
-fields outside this subset (image presence, probes, security contexts,
-lifecycle hooks) fail at pod-creation time rather than at admission.
-Documented in README "Known gaps".
+action legality (incl. exclusiveness rules), container identity
+(DNS-1123 names, non-empty image), resource quantity syntax and
+requests≤limits, restart-policy allowed values, port legality, env-var
+names, volume-mount/volume cross-references, and pod volume/hostname/
+subdomain identity.  The reference runs the complete vendored k8s
+PodTemplateSpec validators (admit_job.go:194+ → k8s
+validation.ValidatePodTemplateSpec); fields outside this subset
+(probes, security contexts, lifecycle hooks) fail at pod-creation time
+rather than at admission.  Documented in README "Known gaps".
 """
 
 from __future__ import annotations
@@ -133,6 +134,12 @@ def _validate_task_template(task: batch.TaskSpec, index: int) -> List[str]:
         if container.name in container_names:
             msgs.append(f"{cpath}.name: duplicate container name {container.name!r};")
         container_names.add(container.name)
+
+        # k8s validation.ValidateContainers: image is required — an
+        # imageless template is undeployable and previously failed only
+        # at pod-creation time, far from the submitter (admit_job.go:194+)
+        if not container.image:
+            msgs.append(f"{cpath}.image: required;")
 
         resources = container.resources or {}
         parsed = {}

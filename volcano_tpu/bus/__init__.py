@@ -1,0 +1,49 @@
+"""Out-of-process API-server bus.
+
+The reference system is three independently deployed binaries plus a
+CLI meeting at a network API server; this package is that meeting
+point for the standalone build:
+
+* ``BusServer`` serves an in-process ``APIServer`` store over TCP with
+  CRUD, list, watch streams (resume, bookmarks, 410-Gone relist), and
+  remote admission review.
+* ``RemoteAPIServer`` is the drop-in client: the same interface as the
+  in-process store, plus reconnect/backoff and informer-grade watch
+  resync.
+* ``connect_bus`` resolves a ``--bus tcp://host:port`` flag into a
+  backend: remote when given, fresh in-process store otherwise.
+
+Run the daemon with ``python -m volcano_tpu.cmd.apiserver``.
+"""
+
+from volcano_tpu.bus.protocol import BusError, BusTimeoutError, parse_bus_url
+from volcano_tpu.bus.remote import RemoteAPIServer
+from volcano_tpu.bus.server import BusServer
+
+
+def connect_bus(bus: str = "", timeout: float = 10.0, wait: float = 30.0):
+    """``--bus`` flag resolution shared by every binary (daemon mains,
+    vtctl, local_up): an address returns a ``RemoteAPIServer`` that is
+    already reachable — or raises ``BusError`` after ``wait`` seconds,
+    so misconfiguration fails loudly at startup instead of as an
+    endless reconnect loop behind a green healthz.  Empty returns a
+    standalone in-process ``APIServer``."""
+    if bus:
+        api = RemoteAPIServer(bus, timeout=timeout)
+        if not api.wait_ready(wait):
+            api.close()
+            raise BusError(f"bus {bus} unreachable after {wait:.0f}s")
+        return api
+    from volcano_tpu.client.apiserver import APIServer
+
+    return APIServer()
+
+
+__all__ = [
+    "BusError",
+    "BusServer",
+    "BusTimeoutError",
+    "RemoteAPIServer",
+    "connect_bus",
+    "parse_bus_url",
+]

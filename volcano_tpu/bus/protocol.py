@@ -1,0 +1,161 @@
+"""Bus wire protocol: framing, object serde, and error mapping.
+
+The out-of-process bus generalizes the compute-plane boundary
+(serving/compute_plane.py) from "ship one packed snapshot" to "be the
+API server": the same length-prefixed, versioned frame discipline, but
+carrying JSON-encoded API objects and watch streams over TCP.
+
+Frame layout (little-endian):
+
+    ``VBUS`` magic + u16 version + u16 message type + u32 correlation id
+    + u32 payload length, then a JSON payload.
+
+The correlation id demultiplexes one duplex connection: for T_REQ /
+T_RESP / T_ERROR it is the client-assigned request id; for watch frames
+(T_WATCH_EVENT / T_BOOKMARK) it is the client-assigned watch id; for
+admission review frames (T_ADMIT_REQ / T_ADMIT_RESP) it is the
+server-assigned review id.  Payloads are pure JSON — like the compute
+plane, the wire is free of pickle so an untrusted peer cannot execute
+code.
+
+Objects cross the wire via the same dataclass round-trip the in-process
+store uses for ``clone()`` (apis/serde), so a remote create/get is
+byte-equivalent to an in-process one.  ``KINDS`` is the decode registry:
+every K8sObject kind the store can hold.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Dict, Optional, Tuple
+
+from volcano_tpu.apis import batch, core, scheduling, scheme
+from volcano_tpu.apis import bus as apis_bus
+from volcano_tpu.client.apiserver import (
+    AdmissionError,
+    AlreadyExistsError,
+    ApiError,
+    ConflictError,
+    NotFoundError,
+)
+
+MAGIC = b"VBUS"
+VERSION = 1
+
+T_REQ = 1            # client → server: one store operation
+T_RESP = 2           # server → client: success payload for a T_REQ
+T_ERROR = 3          # server → client: typed failure for a T_REQ
+T_WATCH_EVENT = 4    # server → client: one watch event (id = watch id)
+T_BOOKMARK = 5       # server → client: watch progress marker
+# 6 reserved (was an unused stream-lost signal; 410-Gone is expressed
+# as the watch response's ``resumed: false`` instead)
+T_PING = 7
+T_PONG = 8
+T_ADMIT_REQ = 9      # server → client: admission review request
+T_ADMIT_RESP = 10    # client → server: admission review verdict
+
+_HEADER = struct.Struct("<4sHHII")
+
+#: decode registry — every kind the in-process store can hold.  Kind
+#: names are class names (K8sObject.kind), so registration is mechanical.
+KINDS: Dict[str, type] = {
+    cls.__name__: cls
+    for cls in (
+        core.Pod, core.Node, core.PriorityClass, core.ConfigMap,
+        core.Secret, core.Service, core.PersistentVolumeClaim,
+        core.NetworkPolicy, core.Event,
+        batch.Job,
+        scheduling.PodGroup, scheduling.Queue,
+        scheme.PodGroupV1alpha1, scheme.QueueV1alpha1,
+        apis_bus.Command,
+    )
+}
+
+#: wire error name → exception class; unknown names fall back to ApiError
+ERRORS: Dict[str, type] = {
+    cls.__name__: cls
+    for cls in (
+        ApiError, NotFoundError, AlreadyExistsError, ConflictError,
+        AdmissionError,
+    )
+}
+
+
+class BusError(ApiError):
+    """Bus transport failure (connection refused/lost, protocol error).
+
+    Subclasses ApiError so existing ``except ApiError`` call sites (CLI,
+    electors, controllers) degrade gracefully instead of crashing."""
+
+
+class BusTimeoutError(BusError):
+    """A request did not complete within its per-call timeout."""
+
+
+def encode_obj(obj) -> Optional[dict]:
+    """API object → wire dict (kind included for the decode registry)."""
+    return None if obj is None else obj.to_dict()
+
+
+def decode_obj(data: Optional[dict]):
+    """Wire dict → API object via the kind registry."""
+    if data is None:
+        return None
+    kind = data.get("kind")
+    cls = KINDS.get(kind)
+    if cls is None:
+        raise BusError(f"unknown kind on the wire: {kind!r}")
+    return cls.from_dict(data)
+
+
+def error_payload(exc: Exception) -> dict:
+    name = type(exc).__name__
+    if name not in ERRORS:
+        name = "ApiError"
+    return {"error": name, "message": str(exc)}
+
+
+def raise_error(payload: dict) -> None:
+    cls = ERRORS.get(payload.get("error", ""), ApiError)
+    raise cls(payload.get("message", "remote error"))
+
+
+def parse_bus_url(url: str) -> Tuple[str, int]:
+    """``tcp://host:port`` → (host, port).  A bare ``host:port`` is
+    accepted for convenience."""
+    if url.startswith("tcp://"):
+        url = url[len("tcp://"):]
+    elif "://" in url:
+        raise ValueError(f"unsupported bus scheme in {url!r} (use tcp://)")
+    host, sep, port = url.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(f"bus address needs host:port, got {url!r}")
+    return host or "127.0.0.1", int(port)
+
+
+def send_frame(sock: socket.socket, mtype: int, corr_id: int, payload: dict) -> None:
+    body = json.dumps(payload, separators=(",", ":")).encode()
+    sock.sendall(_HEADER.pack(MAGIC, VERSION, mtype, corr_id, len(body)) + body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> Tuple[int, int, dict]:
+    head = _recv_exact(sock, _HEADER.size)
+    magic, version, mtype, corr_id, length = _HEADER.unpack(head)
+    if magic != MAGIC:
+        raise ValueError("bad magic")
+    if version != VERSION:
+        raise ValueError(f"unsupported bus protocol version {version}")
+    payload = json.loads(_recv_exact(sock, length).decode()) if length else {}
+    return mtype, corr_id, payload

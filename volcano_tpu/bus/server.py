@@ -1,0 +1,491 @@
+"""``BusServer`` — the network face of the in-process API server.
+
+Wraps a ``client.apiserver.APIServer`` store behind the frame protocol
+in ``bus.protocol`` over TCP, turning the single-process object store
+into the deployable bus the reference architecture meets at
+(cmd/scheduler/main.go:46, cmd/admission/app/server.go:37-99):
+
+* **CRUD + list** proxy straight to the wrapped store, so semantics
+  (optimistic concurrency, owner-reference cascade, admission chain)
+  are exactly the in-process ones.
+* **Watch streams**: every store mutation is stamped with a global bus
+  sequence number and retained in a bounded backlog.  A watch request
+  carrying ``(epoch, resume_seq)`` replays the missed suffix when the
+  backlog still covers it; otherwise the server answers
+  ``resumed: false`` — the 410-Gone "relist required" of the k8s
+  watch API — and the client re-lists.  Periodic bookmarks advance the
+  client's resume point through quiet periods.
+* **Remote admission**: a connection may register as the webhook for a
+  (kind, operation); the server forwards CREATE/UPDATE objects to it as
+  admission-review frames and waits for the verdict before touching the
+  store — the out-of-process equivalent of the reference's webhook
+  configurations.  Reviews run *before* the store transaction (exactly
+  the k8s ordering), so a webhook that calls back into the bus cannot
+  deadlock on the store lock.
+
+Event fan-out happens under the store lock (the store's own ``_notify``
+discipline), which gives every subscriber one total order; delivery is
+decoupled through per-connection outbound queues so a slow or dead peer
+can never stall the store — it overflows its queue and is disconnected,
+after which it resyncs via resume-or-relist.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from volcano_tpu.bus import protocol
+from volcano_tpu.client.apiserver import AdmissionError, ApiError, APIServer
+from volcano_tpu.metrics import metrics
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: outbound frames buffered per connection before the peer is declared
+#: too slow and disconnected (it will resync via resume-or-relist)
+_OUTBOUND_DEPTH = 16384
+
+
+class _Conn:
+    """One accepted connection: a reader (request handler) thread plus a
+    writer thread draining the outbound queue, so watch pushes and
+    admission reviews never block the store-side notifier."""
+
+    def __init__(self, sock: socket.socket, peer):
+        self.sock = sock
+        self.peer = peer
+        self.outbound: "queue.Queue[Optional[Tuple[int, int, dict]]]" = queue.Queue(
+            maxsize=_OUTBOUND_DEPTH
+        )
+        self.closed = False
+        #: watch_id → kind, for cleanup on close
+        self.watches: Dict[int, str] = {}
+        #: review_id → waiter, resolved by T_ADMIT_RESP frames
+        self.reviews: Dict[int, dict] = {}
+        self._lock = threading.Lock()
+
+    def push(self, mtype: int, corr_id: int, payload: dict) -> bool:
+        """Enqueue a frame; returns False (and kills the connection) when
+        the peer is too slow to keep up."""
+        if self.closed:
+            return False
+        try:
+            self.outbound.put_nowait((mtype, corr_id, payload))
+            return True
+        except queue.Full:
+            log.error("bus peer %s overflowed its outbound queue; disconnecting", self.peer)
+            self.kill()
+            return False
+
+    def kill(self) -> None:
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        # unblock the writer thread and fail pending admission reviews
+        try:
+            self.outbound.put_nowait(None)
+        except queue.Full:
+            pass
+        for waiter in list(self.reviews.values()):
+            waiter["result"] = None
+            waiter["event"].set()
+        self.reviews.clear()
+
+    def write_loop(self) -> None:
+        while True:
+            item = self.outbound.get()
+            if item is None or self.closed:
+                return
+            mtype, corr_id, payload = item
+            try:
+                protocol.send_frame(self.sock, mtype, corr_id, payload)
+            except (OSError, ValueError):
+                self.kill()
+                return
+
+
+class BusServer:
+    """Serve an ``APIServer`` store over TCP.  ``port=0`` binds an
+    ephemeral port (read it back from ``.port`` after ``start()``)."""
+
+    def __init__(
+        self,
+        api: APIServer,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        backlog_size: int = 4096,
+        bookmark_interval: float = 2.0,
+        admission_timeout: float = 10.0,
+    ):
+        self.api = api
+        self.host = host
+        self._port = port
+        self.backlog_size = backlog_size
+        self.bookmark_interval = bookmark_interval
+        self.admission_timeout = admission_timeout
+        #: epoch: identifies THIS server incarnation.  A resume token
+        #: from another incarnation can never be judged against our
+        #: sequence numbers, so it is answered with relist-required.
+        self.epoch = uuid.uuid4().hex
+        self._seq = 0
+        self._backlog: List[dict] = []
+        #: kind → [(conn, watch_id)] live subscriptions
+        self._subs: Dict[str, List[Tuple[_Conn, int]]] = {}
+        #: (kind, operation) → [conn] remote admission registrations;
+        #: guarded by _admission_lock — a reconnecting webhook races its
+        #: old connection's cleanup, and an unguarded prune-empty-key
+        #: could strand the fresh registration on an orphaned list
+        self._admission: Dict[Tuple[str, str], List[_Conn]] = {}
+        self._admission_lock = threading.Lock()
+        self._review_id = 0
+        self._review_lock = threading.Lock()
+        self._central_watchers: List[Tuple[str, object]] = []
+        self._listener: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        self._conns: List[_Conn] = []
+        self._conns_lock = threading.Lock()
+        self._stop = threading.Event()
+
+    # ---- lifecycle ----
+
+    @property
+    def port(self) -> int:
+        assert self._listener is not None, "server not started"
+        return self._listener.getsockname()[1]
+
+    def start(self) -> "BusServer":
+        # bind first, subscribe after: a failed bind must not leave
+        # central watchers attached (a retried start() would then record
+        # every store mutation twice, duplicating all watch streams)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # a restarted server re-binding its fixed port (the kill-and-
+        # resume scenario) can race not-yet-reaped sockets of the
+        # previous incarnation — retry briefly instead of crashing
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                self._listener.bind((self.host, self._port))
+                break
+            except OSError:
+                if self._port == 0 or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
+        self._listener.listen(64)
+        for kind in protocol.KINDS:
+            handler = self._make_central_watcher(kind)
+            self.api.watch(kind, handler, send_initial=False)
+            self._central_watchers.append((kind, handler))
+        accept = threading.Thread(
+            target=self._accept_loop, name="vtpu-bus-accept", daemon=True
+        )
+        bookmark = threading.Thread(
+            target=self._bookmark_loop, name="vtpu-bus-bookmark", daemon=True
+        )
+        self._threads = [accept, bookmark]
+        accept.start()
+        bookmark.start()
+        log.info("bus serving on %s:%d (epoch %s)", self.host, self.port, self.epoch[:8])
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            conn.kill()
+        # detach the central watchers so a restarted server on the same
+        # store does not leave this incarnation's handlers firing forever
+        for kind, handler in self._central_watchers:
+            self.api.unwatch(kind, handler)
+        self._central_watchers = []
+
+    @property
+    def running(self) -> bool:
+        return self._listener is not None and not self._stop.is_set()
+
+    # ---- event backlog + fan-out (runs under the store lock) ----
+
+    def _make_central_watcher(self, kind: str):
+        def on_event(event, old, new):
+            self._seq += 1
+            entry = {
+                "seq": self._seq,
+                "kind": kind,
+                "event": event,
+                "old": protocol.encode_obj(old),
+                "new": protocol.encode_obj(new),
+                "ts": time.time(),
+            }
+            self._backlog.append(entry)
+            if len(self._backlog) > self.backlog_size:
+                del self._backlog[: len(self._backlog) - self.backlog_size]
+            for conn, watch_id in self._subs.get(kind, []):
+                conn.push(protocol.T_WATCH_EVENT, watch_id, entry)
+
+        return on_event
+
+    def _bookmark_loop(self) -> None:
+        while not self._stop.wait(self.bookmark_interval):
+            with self.api.locked():
+                payload = {"seq": self._seq, "ts": time.time()}
+                for subs in self._subs.values():
+                    for conn, watch_id in subs:
+                        conn.push(protocol.T_BOOKMARK, watch_id, payload)
+
+    # ---- connections ----
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            listener = self._listener  # stop() may null it concurrently
+            if listener is None:
+                return
+            try:
+                sock, peer = listener.accept()
+            except OSError:
+                return
+            if self._stop.is_set():
+                # accepted in the same instant stop() closed the
+                # listener — drop it so no client talks to a dead server
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            conn = _Conn(sock, peer)
+            with self._conns_lock:
+                self._conns.append(conn)
+            threading.Thread(
+                target=conn.write_loop, name="vtpu-bus-writer", daemon=True
+            ).start()
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="vtpu-bus-handler", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: _Conn) -> None:
+        try:
+            while not conn.closed:
+                try:
+                    mtype, corr_id, payload = protocol.recv_frame(conn.sock)
+                except (ConnectionError, OSError):
+                    return
+                except ValueError as e:
+                    conn.push(protocol.T_ERROR, 0, protocol.error_payload(
+                        protocol.BusError(str(e))))
+                    return
+                if mtype == protocol.T_PING:
+                    conn.push(protocol.T_PONG, corr_id, {})
+                elif mtype == protocol.T_ADMIT_RESP:
+                    waiter = conn.reviews.pop(corr_id, None)
+                    if waiter is not None:
+                        waiter["result"] = payload
+                        waiter["event"].set()
+                elif mtype == protocol.T_REQ:
+                    # one thread per request, NOT inline: a create whose
+                    # admission reviewer lives on THIS connection blocks
+                    # waiting for a T_ADMIT_RESP that only this reader
+                    # can receive — and a reviewer's own read-back calls
+                    # must be servable while another request is parked
+                    # in a review.  Ordering is preserved where it
+                    # matters: each RemoteAPIServer caller thread is
+                    # synchronous, so its requests never overlap.
+                    threading.Thread(
+                        target=self._handle_request,
+                        args=(conn, corr_id, payload),
+                        name="vtpu-bus-request", daemon=True,
+                    ).start()
+                # other types are server→client only; ignore
+        finally:
+            self._cleanup_conn(conn)
+
+    def _cleanup_conn(self, conn: _Conn) -> None:
+        conn.kill()
+        with self._conns_lock:
+            if conn in self._conns:
+                self._conns.remove(conn)
+        with self.api.locked():
+            for watch_id, kind in conn.watches.items():
+                subs = self._subs.get(kind, [])
+                if (conn, watch_id) in subs:
+                    subs.remove((conn, watch_id))
+            conn.watches.clear()
+            self._update_watcher_gauge()
+        with self._admission_lock:
+            for key, conns in list(self._admission.items()):
+                if conn in conns:
+                    conns.remove(conn)
+                if not conns:
+                    self._admission.pop(key, None)
+
+    def _update_watcher_gauge(self) -> None:
+        metrics.update_bus_server_watchers(
+            sum(len(s) for s in self._subs.values())
+        )
+
+    # ---- request dispatch ----
+
+    def _handle_request(self, conn: _Conn, req_id: int, payload: dict) -> None:
+        op = payload.get("op", "")
+        start = time.perf_counter()
+        try:
+            result = self._execute(conn, req_id, payload, op)
+            if result is not None:
+                conn.push(protocol.T_RESP, req_id, result)
+            metrics.observe_bus_server_request(op, time.perf_counter() - start, "ok")
+        except ApiError as e:
+            conn.push(protocol.T_ERROR, req_id, protocol.error_payload(e))
+            metrics.observe_bus_server_request(op, time.perf_counter() - start, "error")
+        except Exception as e:  # noqa: BLE001 — report, keep serving
+            log.error("bus request %s failed: %s", op, e)
+            conn.push(protocol.T_ERROR, req_id, protocol.error_payload(ApiError(str(e))))
+            metrics.observe_bus_server_request(op, time.perf_counter() - start, "error")
+
+    def _execute(self, conn: _Conn, req_id: int, payload: dict, op: str):
+        api = self.api
+        if op == "create":
+            obj = protocol.decode_obj(payload["object"])
+            obj = self._remote_admission(obj.kind, "CREATE", obj)
+            return {"object": protocol.encode_obj(api.create(obj))}
+        if op == "update":
+            obj = protocol.decode_obj(payload["object"])
+            obj = self._remote_admission(obj.kind, "UPDATE", obj)
+            return {"object": protocol.encode_obj(
+                api.update(obj, expected_rv=payload.get("expected_rv")))}
+        if op == "update_status":
+            obj = protocol.decode_obj(payload["object"])
+            return {"object": protocol.encode_obj(api.update_status(obj))}
+        if op == "get":
+            obj = api.get(payload["kind"], payload["namespace"], payload["name"])
+            return {"object": protocol.encode_obj(obj)}
+        if op == "list":
+            objs = api.list(payload["kind"], payload.get("namespace"))
+            return {"objects": [protocol.encode_obj(o) for o in objs]}
+        if op == "delete":
+            old = api.delete(payload["kind"], payload["namespace"], payload["name"])
+            return {"object": protocol.encode_obj(old)}
+        if op == "watch":
+            self._handle_watch(conn, req_id, payload)
+            return None  # responses pushed inline for ordering
+        if op == "unwatch":
+            watch_id = int(payload["watch_id"])
+            with api.locked():
+                kind = conn.watches.pop(watch_id, None)
+                if kind is not None:
+                    subs = self._subs.get(kind, [])
+                    subs[:] = [s for s in subs if s != (conn, watch_id)]
+                    self._update_watcher_gauge()
+            return {"unwatched": kind is not None}
+        if op == "register_admission":
+            key = (payload["kind"], payload["operation"])
+            with self._admission_lock:
+                conns = self._admission.setdefault(key, [])
+                if conn not in conns:
+                    conns.append(conn)
+            return {"registered": True}
+        raise ApiError(f"unknown bus op {op!r}")
+
+    # ---- watch ----
+
+    def _handle_watch(self, conn: _Conn, req_id: int, payload: dict) -> None:
+        """Establish a watch.  Everything happens under the store lock so
+        the response, any backlog replay, and the live subscription form
+        one gapless, duplicate-free sequence."""
+        kind = payload["kind"]
+        if kind not in protocol.KINDS:
+            raise ApiError(f"unknown kind {kind!r}")
+        watch_id = int(payload["watch_id"])
+        resume_seq = payload.get("resume_seq")
+        with self.api.locked():
+            if resume_seq is not None:
+                oldest_covered = self._seq - len(self._backlog)
+                if payload.get("epoch") != self.epoch or resume_seq < oldest_covered:
+                    # 410 Gone: this incarnation cannot prove the client
+                    # missed nothing — a fresh list is required
+                    conn.push(protocol.T_RESP, req_id, {
+                        "resumed": False, "epoch": self.epoch, "seq": self._seq,
+                    })
+                    return
+                conn.push(protocol.T_RESP, req_id, {
+                    "resumed": True, "epoch": self.epoch, "seq": self._seq,
+                })
+                for entry in self._backlog:
+                    if entry["seq"] > resume_seq and entry["kind"] == kind:
+                        conn.push(protocol.T_WATCH_EVENT, watch_id, entry)
+            else:
+                initial = [protocol.encode_obj(o) for o in self.api.list(kind)]
+                conn.push(protocol.T_RESP, req_id, {
+                    "resumed": True, "epoch": self.epoch, "seq": self._seq,
+                    "initial": initial,
+                })
+            # re-establishment on a live connection replaces the old
+            # subscription — a watch id is never subscribed twice
+            subs = self._subs.setdefault(kind, [])
+            subs[:] = [s for s in subs if s != (conn, watch_id)]
+            subs.append((conn, watch_id))
+            conn.watches[watch_id] = kind
+            self._update_watcher_gauge()
+
+    # ---- remote admission ----
+
+    def _remote_admission(self, kind: str, operation: str, obj):
+        """Run registered remote reviews in order, mutating as we go.
+        Runs BEFORE the store transaction (k8s webhook ordering) so a
+        webhook that reads back through the bus cannot deadlock."""
+        with self._admission_lock:
+            conns = list(self._admission.get((kind, operation), ()))
+        if not conns:
+            return obj
+        data = protocol.encode_obj(obj)
+        for conn in conns:
+            if conn.closed:
+                continue
+            with self._review_lock:
+                self._review_id += 1
+                review_id = self._review_id
+            waiter = {"event": threading.Event(), "result": None}
+            conn.reviews[review_id] = waiter
+            if not conn.push(protocol.T_ADMIT_REQ, review_id, {
+                "kind": kind, "operation": operation, "object": data,
+            }):
+                continue
+            if not waiter["event"].wait(self.admission_timeout):
+                conn.reviews.pop(review_id, None)
+                raise AdmissionError(
+                    f"admission review for {kind}/{operation} timed out"
+                )
+            result = waiter["result"]
+            if result is None:
+                # reviewer died mid-flight — failure-open, like a webhook
+                # with failurePolicy: Ignore whose endpoint vanished
+                log.error("admission reviewer for %s/%s disconnected mid-review",
+                          kind, operation)
+                continue
+            if not result.get("allowed", False):
+                raise AdmissionError(result.get("message") or
+                                     "denied by admission webhook")
+            if result.get("object") is not None:
+                data = result["object"]
+        return protocol.decode_obj(data)

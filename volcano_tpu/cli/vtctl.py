@@ -367,6 +367,11 @@ def _trace_export(vc: VolcanoClient, args, out) -> int:
 
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="vtctl", description="volcano-tpu control CLI")
+    parser.add_argument(
+        "--bus", default="",
+        help="talk to a live vtpu-apiserver at tcp://host:port (the "
+        "kubeconfig equivalent for the multi-process topology)",
+    )
     sub = parser.add_subparsers(dest="group", required=True)
 
     job = sub.add_parser("job").add_subparsers(dest="cmd", required=True)
@@ -469,6 +474,15 @@ _HANDLERS = {
 def main(argv: Optional[List[str]] = None, api: Optional[APIServer] = None, out=None) -> int:
     out = out if out is not None else sys.stdout
     args = build_parser().parse_args(argv)
+    remote = None
+    if api is None and getattr(args, "bus", ""):
+        from volcano_tpu.bus import BusError, connect_bus
+
+        try:
+            api = remote = connect_bus(args.bus, wait=10.0)
+        except BusError as e:
+            print(f"error: {e}", file=out)
+            return 1
     if api is None:
         api = APIServer()  # empty standalone instance
     vc = VolcanoClient(api)
@@ -488,6 +502,9 @@ def main(argv: Optional[List[str]] = None, api: Optional[APIServer] = None, out=
             print(f"error: {e}", file=out)
             return 1
         raise
+    finally:
+        if remote is not None:
+            remote.close()
 
 
 if __name__ == "__main__":

@@ -5,12 +5,18 @@ Reference architecture: Volcano's only bus is the Kubernetes API server
 REST out.  This standalone framework ships its own in-process equivalent:
 a thread-safe versioned object store with watch fan-out and admission
 hooks.  Controllers, the scheduler cache, admission and the CLI all
-connect here; a real-cluster deployment swaps this module for a k8s client
-behind the same interface.
+connect here.
+
+The swap is real: ``volcano_tpu.bus.RemoteAPIServer`` implements this
+exact interface over TCP against a ``vtpu-apiserver`` daemon (which is
+this store behind ``volcano_tpu.bus.BusServer``), so every consumer runs
+unchanged in either the single-process or the multi-process deployment
+topology — pass ``--bus tcp://host:port`` to any daemon binary.
 """
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -63,6 +69,15 @@ class APIServer:
 
     # ---- helpers ----
 
+    @contextlib.contextmanager
+    def locked(self):
+        """Hold the store lock.  Watch notifications fire under this
+        lock, so a caller holding it can atomically combine a list with
+        a subscription point — the primitive the network bus
+        (volcano_tpu/bus) builds its gapless watch establishment on."""
+        with self._lock:
+            yield
+
     @staticmethod
     def _key(obj) -> str:
         return f"{obj.metadata.namespace}/{obj.metadata.name}"
@@ -97,6 +112,14 @@ class APIServer:
             if send_initial:
                 for obj in list(self._store.get(kind, {}).values()):
                     handler(ADDED, None, obj)
+
+    def unwatch(self, kind: str, handler: WatchHandler) -> None:
+        """Detach a watch handler (a restarted BusServer must not leave
+        its previous incarnation's central watchers firing forever)."""
+        with self._lock:
+            handlers = self._watchers.get(kind, [])
+            if handler in handlers:
+                handlers.remove(handler)
 
     # ---- CRUD ----
 

@@ -9,7 +9,13 @@ election (LeaderElector) gating its work loop.
 """
 
 from volcano_tpu.cmd.admission import AdmissionDaemon
+from volcano_tpu.cmd.apiserver import ApiServerDaemon
 from volcano_tpu.cmd.controllers import ControllersDaemon
 from volcano_tpu.cmd.scheduler import SchedulerDaemon
 
-__all__ = ["AdmissionDaemon", "ControllersDaemon", "SchedulerDaemon"]
+__all__ = [
+    "AdmissionDaemon",
+    "ApiServerDaemon",
+    "ControllersDaemon",
+    "SchedulerDaemon",
+]

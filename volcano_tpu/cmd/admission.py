@@ -12,8 +12,8 @@ import argparse
 import time
 
 from volcano_tpu.admission import register_webhooks
-from volcano_tpu.client import APIServer
-from volcano_tpu.cmd.scheduler import add_common_args
+from volcano_tpu.client import APIServer  # noqa: F401 — the in-process default
+from volcano_tpu.cmd.scheduler import add_common_args, resolve_bus
 from volcano_tpu.serving import ServingServer
 from volcano_tpu.utils.logging import get_logger
 
@@ -52,7 +52,7 @@ def main(argv=None) -> int:
     add_common_args(parser)
     args = parser.parse_args(argv)
     daemon = AdmissionDaemon(
-        APIServer(),
+        resolve_bus(args.bus),
         gate_pods=args.gate_pods,
         listen_host=args.listen_host,
         listen_port=args.listen_port,

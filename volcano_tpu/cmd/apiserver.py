@@ -1,0 +1,130 @@
+"""vtpu-apiserver — the standalone API-server daemon.
+
+The reference deploys Kubernetes' API server as the bus all binaries
+meet at; this is the standalone build's equivalent: the in-process
+object store (client/apiserver.py) served over TCP by
+``bus.BusServer``, plus the standard serving surface (healthz +
+/metrics) every other daemon carries.
+
+With this daemon up, every other binary — vtpu-scheduler,
+vtpu-controllers, vtpu-admission, vtctl — connects with
+``--bus tcp://host:port`` and the system runs as the reference's
+multi-process deployment topology, including cross-process leader
+election (the scheduler's ConfigMap lease lives on this store).
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+from typing import Optional
+
+from volcano_tpu.bus.server import BusServer
+from volcano_tpu.client.apiserver import APIServer
+from volcano_tpu.serving import ServingServer
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+DEFAULT_BUS_PORT = 7180
+
+
+class ApiServerDaemon:
+    """The apiserver binary: store + bus listener + serving surface."""
+
+    def __init__(
+        self,
+        api: Optional[APIServer] = None,
+        listen_host: str = "127.0.0.1",
+        bus_port: int = DEFAULT_BUS_PORT,
+        listen_port: int = 0,
+        backlog_size: int = 4096,
+        bookmark_interval: float = 2.0,
+        debug_enabled: bool = False,
+        seed_nodes: int = 0,
+        seed_node_cpu: str = "8",
+        seed_node_mem: str = "32Gi",
+    ):
+        self.api = api if api is not None else APIServer()
+        self.bus = BusServer(
+            self.api, host=listen_host, port=bus_port,
+            backlog_size=backlog_size, bookmark_interval=bookmark_interval,
+        )
+        self.serving = ServingServer(
+            host=listen_host, port=listen_port,
+            health_check=lambda: self.bus.running,
+            debug_enabled=debug_enabled,
+        )
+        #: synthetic node pool + default queue on startup (idempotent).
+        #: A real cluster's nodes arrive from kubelets; the standalone
+        #: build's arrive from whoever owns the store — this daemon in
+        #: the multi-process topology, vtpu-local-up otherwise.
+        self.seed_nodes = seed_nodes
+        self.seed_node_cpu = seed_node_cpu
+        self.seed_node_mem = seed_node_mem
+
+    def start(self) -> "ApiServerDaemon":
+        if self.seed_nodes > 0:
+            from volcano_tpu.cmd.local_up import seed_cluster
+
+            seed_cluster(self.api, self.seed_nodes,
+                         self.seed_node_cpu, self.seed_node_mem)
+        self.bus.start()
+        self.serving.start()
+        log.info(
+            "apiserver up: bus on :%d, metrics on :%d",
+            self.bus.port, self.serving.port,
+        )
+        return self
+
+    def stop(self) -> None:
+        self.bus.stop()
+        self.serving.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="vtpu-apiserver")
+    parser.add_argument("--listen-host", default="127.0.0.1")
+    parser.add_argument(
+        "--port", type=int, default=DEFAULT_BUS_PORT,
+        help="bus TCP port the daemons and vtctl connect to",
+    )
+    parser.add_argument(
+        "--listen-port", type=int, default=8083,
+        help="healthz/metrics HTTP port",
+    )
+    parser.add_argument(
+        "--backlog-size", type=int, default=4096,
+        help="watch-event backlog depth; resumes older than this relist",
+    )
+    parser.add_argument("--bookmark-interval", type=float, default=2.0)
+    parser.add_argument("--enable-debug-stacks", action="store_true")
+    parser.add_argument(
+        "--seed-nodes", type=int, default=0,
+        help="create a synthetic node pool + default queue on startup "
+        "(the standalone cluster's kubelet substitute; 0 = off)",
+    )
+    parser.add_argument("--seed-node-cpu", default="8")
+    parser.add_argument("--seed-node-mem", default="32Gi")
+    args = parser.parse_args(argv)
+
+    daemon = ApiServerDaemon(
+        listen_host=args.listen_host,
+        bus_port=args.port,
+        listen_port=args.listen_port,
+        backlog_size=args.backlog_size,
+        bookmark_interval=args.bookmark_interval,
+        debug_enabled=args.enable_debug_stacks,
+        seed_nodes=args.seed_nodes,
+        seed_node_cpu=args.seed_node_cpu,
+        seed_node_mem=args.seed_node_mem,
+    ).start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -9,9 +9,9 @@ from __future__ import annotations
 
 import argparse
 
-from volcano_tpu.client import APIServer
+from volcano_tpu.client import APIServer  # noqa: F401 — the in-process default
 from volcano_tpu.cmd.daemon import BaseDaemon, serve_forever
-from volcano_tpu.cmd.scheduler import add_common_args
+from volcano_tpu.cmd.scheduler import add_common_args, resolve_bus
 from volcano_tpu.controllers import (
     GarbageCollector,
     JobController,
@@ -51,7 +51,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     return serve_forever(
         ControllersDaemon(
-            APIServer(),
+            resolve_bus(args.bus),
             period=args.period,
             listen_host=args.listen_host,
             listen_port=args.listen_port,

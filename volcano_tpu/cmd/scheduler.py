@@ -56,10 +56,27 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--leader-elect", action="store_true")
     parser.add_argument("--leader-elect-id", default=None)
     parser.add_argument(
+        "--bus", default="",
+        help="connect to an out-of-process vtpu-apiserver at "
+        "tcp://host:port instead of running an in-process store "
+        "(the reference's multi-binary deployment topology)",
+    )
+    parser.add_argument(
         "--enable-debug-stacks", action="store_true",
         help="serve /debug/stacks to non-loopback clients (forensics; "
         "stack dumps expose internals — default loopback-only)",
     )
+
+
+def resolve_bus(bus: str):
+    """``--bus`` → backend for the daemon mains: dial failures become a
+    clean exit instead of a traceback."""
+    from volcano_tpu.bus import BusError, connect_bus
+
+    try:
+        return connect_bus(bus)
+    except BusError as e:
+        raise SystemExit(str(e)) from e
 
 
 def main(argv=None) -> int:
@@ -85,8 +102,36 @@ def main(argv=None) -> int:
         "first cycle (first compile is ~20-40s on TPU; same flag as "
         "vtpu-compute-plane)",
     )
+    # Host-fallback node subsampling (options.go:38-40, honored by the
+    # host predicate loop via scheduler_helper's feasible-node budget).
+    # The device kernels score all nodes at once, so these only matter
+    # on the no-TPU path — exactly where large node counts hurt.
+    parser.add_argument(
+        "--percentage-nodes-to-find", type=int, default=100,
+        help="stop the host predicate scan after finding this percent "
+        "of nodes feasible (100 = scan all; 0 = adaptive, shrinking "
+        "with cluster size like the reference)",
+    )
+    parser.add_argument(
+        "--minimum-feasible-nodes", type=int, default=100,
+        help="never subsample below this many feasible nodes "
+        "(options.go MinNodesToFind)",
+    )
+    parser.add_argument(
+        "--minimum-percentage-nodes-to-find", type=int, default=5,
+        help="floor for the adaptive percentage "
+        "(options.go MinPercentageOfNodesToFind)",
+    )
     add_common_args(parser)
     args = parser.parse_args(argv)
+
+    from volcano_tpu.scheduler import util as sched_util
+
+    sched_util.server_opts = sched_util.ServerOpts(
+        min_nodes_to_find=args.minimum_feasible_nodes,
+        min_percentage_of_nodes_to_find=args.minimum_percentage_nodes_to_find,
+        percentage_of_nodes_to_find=args.percentage_nodes_to_find,
+    )
 
     if args.warmup:
         import os
@@ -108,7 +153,7 @@ def main(argv=None) -> int:
 
     return serve_forever(
         SchedulerDaemon(
-            APIServer(),
+            resolve_bus(args.bus),
             scheduler_conf=args.scheduler_conf,
             schedule_period=args.schedule_period,
             scheduler_name=args.scheduler_name,

@@ -2,25 +2,32 @@
 
 Reference: installer/helm/chart/volcano/{Chart.yaml,values.yaml,
 templates/{scheduler,controllers,admission}.yaml}.  The reference ships
-a Helm chart whose values.yaml parametrizes image names/tags, the
-admission secret, and the scheduler policy file, and whose templates
-stamp out one Deployment + RBAC per daemon.  This build has no Helm in
-the image and a different topology (the bus is the in-process API
-server, so the three daemons share one Deployment — see
-deploy/kubernetes/volcano-tpu.yaml), so the chart equivalent is a pure
-renderer: a values tree (defaults below, overridable via YAML file and
+a Helm chart whose values.yaml parametrizes image names/tags and the
+scheduler policy file, and whose templates stamp out one Deployment per
+daemon.  This build renders the same topology with no Helm in the
+image: a values tree (defaults below, overridable via YAML file and
 ``--set`` paths, same precedence helm uses) fed through ``render()``
 into the full manifest set.
 
-Topology rendered:
+Topology rendered (the reference's multi-binary deployment, carried by
+the out-of-process bus in volcano_tpu/bus):
   - Namespace
   - ConfigMap holding the scheduler policy (templates/scheduler.yaml's
     ``{{ .Files.Glob .Values.basic.scheduler_config_file }}`` inlining)
-  - One Deployment: control-plane container (vtpu-local-up) plus, when
-    ``compute_plane.enabled``, the kernel sidecar container
-    (vtpu-compute-plane) sharing a socket volume — the process boundary
-    from serving/compute_plane.py deployed as a colocated container.
-  - Service exposing scheduler/controllers/admission ports.
+  - ``<name>-apiserver`` Deployment + Service: the vtpu-apiserver
+    daemon serving the bus over TCP — the store every other daemon
+    dials with ``--bus``.
+  - ``<name>-scheduler`` Deployment: vtpu-scheduler over the bus; when
+    ``replicas > 1`` the copies run ConfigMap-lease leader election
+    THROUGH the bus, so a killed pod's standby takes over — real
+    cross-pod HA (opt-in: every scheduler pod demands a TPU slice, so
+    a standby needs spare accelerator capacity; see scheduler.replicas
+    below).  When ``compute_plane.enabled``, each scheduler pod carries
+    the kernel sidecar container sharing a socket volume.
+  - ``<name>-controllers`` Deployment: two leader-elected replicas by
+    default — controllers demand no accelerator, so HA is free.
+  - ``<name>-admission`` Deployment: registers its webhooks over the
+    bus; the apiserver forwards admission reviews to it.
 """
 
 from __future__ import annotations
@@ -41,12 +48,28 @@ DEFAULT_VALUES: Dict[str, Any] = {
         # empty -> the built-in DEFAULT_SCHEDULER_CONF is inlined
         "scheduler_config_file": "",
     },
+    "bus": {
+        "port": 7180,
+    },
+    "apiserver": {
+        "port": 8083,
+        "backlog_size": 4096,
+    },
     "scheduler": {
+        # synthetic node pool the apiserver seeds (kubelet substitute)
         "nodes": 8,
         "port": 8080,
+        # every scheduler pod demands a full TPU slice (sidecar or
+        # in-process), so a standby replica needs SPARE accelerator
+        # capacity — on a single-slice cluster it would sit Pending and
+        # the kubelet's restart of a dead leader beats any takeover.
+        # Default to 1; set 2 (adds --leader-elect) where slices exist.
+        "replicas": 1,
     },
     "controllers": {
         "port": 8081,
+        # no accelerator demand — cross-pod HA is free here
+        "replicas": 2,
     },
     "admission": {
         "port": 8082,
@@ -145,6 +168,45 @@ def _scheduler_conf_text(values: Dict[str, Any]) -> str:
     return DEFAULT_SCHEDULER_CONF.strip() + "\n"
 
 
+def _deployment(name: str, ns: str, labels: Dict[str, str],
+                containers: List[Dict[str, Any]],
+                volumes: List[Dict[str, Any]],
+                replicas: int,
+                annotations: Dict[str, str],
+                image_pull_secret: str,
+                strategy: str = "RollingUpdate") -> Dict[str, Any]:
+    pod_spec: Dict[str, Any] = {"containers": containers}
+    if volumes:
+        pod_spec["volumes"] = volumes
+    if image_pull_secret:
+        pod_spec["imagePullSecrets"] = [{"name": image_pull_secret}]
+    template_meta: Dict[str, Any] = {"labels": labels}
+    if annotations:
+        template_meta["annotations"] = annotations
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": ns, "labels": labels},
+        "spec": {
+            "replicas": replicas,
+            # Recreate only where it is forced: the apiserver (two
+            # concurrent store instances behind one Service would split
+            # clients between divergent stores) and the scheduler (a
+            # surge pod could never place — the old pod holds the node's
+            # TPU chips until it dies).  Controllers/admission roll
+            # normally; leader election covers the overlap.
+            "strategy": {"type": strategy},
+            "selector": {"matchLabels": labels},
+            "template": {"metadata": template_meta, "spec": pod_spec},
+        },
+    }
+
+
+def _probe(port: int) -> Dict[str, Any]:
+    return {"httpGet": {"path": "/healthz", "port": port},
+            "periodSeconds": 10}
+
+
 def render(values: Dict[str, Any]) -> List[Tuple[str, Dict[str, Any]]]:
     """Render the manifest set from a values tree.
 
@@ -154,16 +216,26 @@ def render(values: Dict[str, Any]) -> List[Tuple[str, Dict[str, Any]]]:
     name = basic["release_name"]
     ns = basic["namespace"]
     image = f"{basic['image_name']}:{basic['image_tag_version']}"
+    pull_secret = basic.get("image_pull_secret", "")
     cp = values["compute_plane"]
+    bus_port = int(values["bus"]["port"])
+    api_port = int(values["apiserver"]["port"])
     sched_port = int(values["scheduler"]["port"])
     ctrl_port = int(values["controllers"]["port"])
     adm_port = int(values["admission"]["port"])
+    bus_url = f"tcp://{name}-apiserver.{ns}.svc:{bus_port}"
+
+    def scrape(port: int) -> Dict[str, str]:
+        if not values["prometheus"]["scrape"]:
+            return {}
+        return {"prometheus.io/scrape": "true",
+                "prometheus.io/port": str(port)}
 
     manifests: List[Tuple[str, Dict[str, Any]]] = []
 
     # filenames carry the apply order — kubectl apply -f DIR walks the
     # directory lexically, and the Namespace must exist before anything
-    # placed inside it
+    # placed inside it, the apiserver before the daemons that dial it
     manifests.append(("00-namespace.yaml", {
         "apiVersion": "v1",
         "kind": "Namespace",
@@ -177,57 +249,86 @@ def render(values: Dict[str, Any]) -> List[Tuple[str, Dict[str, Any]]]:
         "data": {"volcano-scheduler.conf": _scheduler_conf_text(values)},
     }))
 
-    labels = {"app": name}
-    annotations: Dict[str, str] = {}
-    if values["prometheus"]["scrape"]:
-        annotations = {
-            "prometheus.io/scrape": "true",
-            "prometheus.io/port": str(sched_port),
-        }
+    # ---- apiserver: the bus every other daemon dials ----
+    api_labels = {"app": f"{name}-apiserver"}
+    manifests.append(("20-apiserver-deployment.yaml", _deployment(
+        f"{name}-apiserver", ns, api_labels,
+        containers=[{
+            "name": "apiserver",
+            "image": image,
+            "command": [
+                "vtpu-apiserver",
+                "--listen-host", "0.0.0.0",
+                "--port", str(bus_port),
+                "--listen-port", str(api_port),
+                "--backlog-size", str(int(values["apiserver"]["backlog_size"])),
+                "--seed-nodes", str(int(values["scheduler"]["nodes"])),
+            ],
+            "livenessProbe": _probe(api_port),
+            "ports": [
+                {"containerPort": bus_port, "name": "bus"},
+                {"containerPort": api_port, "name": "metrics"},
+            ],
+        }],
+        volumes=[],
+        # one replica: the store itself is the consistency point (the
+        # reference's etcd-backed apiserver HA is out of scope); daemons
+        # reconnect-and-resync through its restarts
+        replicas=1,
+        annotations=scrape(api_port),
+        image_pull_secret=pull_secret,
+        strategy="Recreate",
+    )))
 
-    control_plane: Dict[str, Any] = {
-        "name": "control-plane",
+    manifests.append(("21-apiserver-service.yaml", {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": f"{name}-apiserver", "namespace": ns,
+                     "labels": api_labels},
+        "spec": {
+            "selector": api_labels,
+            "ports": [
+                {"name": "bus", "port": bus_port},
+                {"name": "metrics", "port": api_port},
+            ],
+        },
+    }))
+
+    # ---- scheduler: leader-elected replicas + compute-plane sidecar ----
+    sched_replicas = int(values["scheduler"].get("replicas", 1))
+    sched_cmd = [
+        "vtpu-scheduler",
+        "--bus", bus_url,
+        "--listen-host", "0.0.0.0",
+        "--listen-port", str(sched_port),
+        "--scheduler-conf", "/etc/volcano-tpu/volcano-scheduler.conf",
+    ]
+    if sched_replicas > 1:
+        sched_cmd.append("--leader-elect")
+    scheduler: Dict[str, Any] = {
+        "name": "scheduler",
         "image": image,
-        # --serve: daemon mode (a pod's stdin is EOF, the interactive
-        # prompt would exit immediately); 0.0.0.0 + fixed ports so the
-        # kubelet probe and the Service actually reach the daemons
-        "command": [
-            "vtpu-local-up", "--serve",
-            "--nodes", str(values["scheduler"]["nodes"]),
-            "--listen-host", "0.0.0.0",
-            "--scheduler-port", str(sched_port),
-            "--controllers-port", str(ctrl_port),
-            "--admission-port", str(adm_port),
-            "--scheduler-conf", "/etc/volcano-tpu/volcano-scheduler.conf",
-        ],
+        "command": sched_cmd,
         "volumeMounts": [
             {"name": "scheduler-config", "mountPath": "/etc/volcano-tpu"},
         ],
-        "livenessProbe": {
-            "httpGet": {"path": "/healthz", "port": sched_port},
-            "periodSeconds": 10,
-        },
-        "ports": [
-            {"containerPort": sched_port, "name": "scheduler"},
-            {"containerPort": ctrl_port, "name": "controllers"},
-            {"containerPort": adm_port, "name": "admission"},
-        ],
+        "livenessProbe": _probe(sched_port),
+        "ports": [{"containerPort": sched_port, "name": "metrics"}],
     }
-    containers = [control_plane]
-    volumes: List[Dict[str, Any]] = [
+    sched_containers = [scheduler]
+    sched_volumes: List[Dict[str, Any]] = [
         {"name": "scheduler-config",
          "configMap": {"name": f"{name}-scheduler-configmap"}},
     ]
-
     if cp["enabled"]:
         socket = f"{cp['socket_dir']}/compute-plane.sock"
-        control_plane["env"] = [{"name": "VTPU_COMPUTE_PLANE", "value": socket}]
-        control_plane["volumeMounts"].append(
+        scheduler["env"] = [{"name": "VTPU_COMPUTE_PLANE", "value": socket}]
+        scheduler["volumeMounts"].append(
             {"name": "compute-plane-socket", "mountPath": cp["socket_dir"]})
         sidecar_cmd = ["vtpu-compute-plane", "--socket", socket]
         if cp["warmup"]:
             sidecar_cmd.append("--warmup")
-        containers.append({
+        sched_containers.append({
             "name": "compute-plane",
             "image": image,
             "command": sidecar_cmd,
@@ -238,52 +339,66 @@ def render(values: Dict[str, Any]) -> List[Tuple[str, Dict[str, Any]]]:
                 "limits": {cp["tpu_resource"]: str(cp["tpu_chips"])},
             },
         })
-        volumes.append({"name": "compute-plane-socket", "emptyDir": {}})
+        sched_volumes.append({"name": "compute-plane-socket", "emptyDir": {}})
     else:
-        # in-process kernels: the control plane itself owns the device,
-        # so the TPU limit moves onto it (the single-container topology
-        # of deploy/kubernetes/volcano-tpu.yaml)
-        control_plane["resources"] = {
+        # in-process kernels: the scheduler itself owns the device, so
+        # the TPU limit moves onto it
+        scheduler["resources"] = {
             "limits": {cp["tpu_resource"]: str(cp["tpu_chips"])},
         }
 
-    pod_spec: Dict[str, Any] = {"containers": containers, "volumes": volumes}
-    if basic.get("image_pull_secret"):
-        pod_spec["imagePullSecrets"] = [{"name": basic["image_pull_secret"]}]
+    manifests.append(("30-scheduler-deployment.yaml", _deployment(
+        f"{name}-scheduler", ns, {"app": f"{name}-scheduler"},
+        containers=sched_containers, volumes=sched_volumes,
+        replicas=sched_replicas,
+        annotations=scrape(sched_port),
+        image_pull_secret=pull_secret,
+        strategy="Recreate",
+    )))
 
-    template_meta: Dict[str, Any] = {"labels": labels}
-    if annotations:
-        template_meta["annotations"] = annotations
+    # ---- controllers ----
+    ctrl_replicas = int(values["controllers"].get("replicas", 1))
+    ctrl_cmd = [
+        "vtpu-controllers",
+        "--bus", bus_url,
+        "--listen-host", "0.0.0.0",
+        "--listen-port", str(ctrl_port),
+    ]
+    if ctrl_replicas > 1:
+        ctrl_cmd.append("--leader-elect")
+    manifests.append(("31-controllers-deployment.yaml", _deployment(
+        f"{name}-controllers", ns, {"app": f"{name}-controllers"},
+        containers=[{
+            "name": "controllers",
+            "image": image,
+            "command": ctrl_cmd,
+            "livenessProbe": _probe(ctrl_port),
+            "ports": [{"containerPort": ctrl_port, "name": "metrics"}],
+        }],
+        volumes=[], replicas=ctrl_replicas,
+        annotations=scrape(ctrl_port),
+        image_pull_secret=pull_secret,
+    )))
 
-    manifests.append(("20-deployment.yaml", {
-        "apiVersion": "apps/v1",
-        "kind": "Deployment",
-        "metadata": {"name": name, "namespace": ns, "labels": labels},
-        "spec": {
-            # one replica by design: the in-process bus makes the pod the
-            # HA unit; leader election arbitrates daemon threads inside it
-            "replicas": 1,
-            # Recreate: a RollingUpdate surge pod could never schedule —
-            # the old pod holds the node's TPU chips until it dies
-            "strategy": {"type": "Recreate"},
-            "selector": {"matchLabels": labels},
-            "template": {"metadata": template_meta, "spec": pod_spec},
-        },
-    }))
-
-    manifests.append(("30-service.yaml", {
-        "apiVersion": "v1",
-        "kind": "Service",
-        "metadata": {"name": name, "namespace": ns, "labels": labels},
-        "spec": {
-            "selector": labels,
-            "ports": [
-                {"name": "scheduler", "port": sched_port},
-                {"name": "controllers", "port": ctrl_port},
-                {"name": "admission", "port": adm_port},
+    # ---- admission ----
+    manifests.append(("32-admission-deployment.yaml", _deployment(
+        f"{name}-admission", ns, {"app": f"{name}-admission"},
+        containers=[{
+            "name": "admission",
+            "image": image,
+            "command": [
+                "vtpu-admission",
+                "--bus", bus_url,
+                "--listen-host", "0.0.0.0",
+                "--listen-port", str(adm_port),
             ],
-        },
-    }))
+            "livenessProbe": _probe(adm_port),
+            "ports": [{"containerPort": adm_port, "name": "metrics"}],
+        }],
+        volumes=[], replicas=1,
+        annotations=scrape(adm_port),
+        image_pull_secret=pull_secret,
+    )))
 
     return manifests
 

@@ -191,6 +191,53 @@ def register_job_retries(job_name: str) -> None:
     registry.inc(f"{_NAMESPACE}_job_retry_counts", {"job": job_name})
 
 
+# ---- bus metrics (the out-of-process API-server boundary) ----
+# Client side instruments every RemoteAPIServer call and the informer
+# resync machinery; server side instruments the vtpu-apiserver daemon.
+# volcano_bus_relists_total is the divergence canary: a relist means a
+# watch stream could not resume and the informer cache was rebuilt.
+
+def observe_bus_request(method: str, seconds: float, code: str) -> None:
+    """code ∈ {ok, error, timeout, disconnected}."""
+    registry.inc(f"{_NAMESPACE}_bus_requests_total",
+                 {"method": method, "code": code})
+    registry.histogram(
+        f"{_NAMESPACE}_bus_request_latency_milliseconds", {"method": method}
+    ).observe(seconds * 1e3)
+
+
+def register_bus_reconnect() -> None:
+    registry.inc(f"{_NAMESPACE}_bus_reconnects_total", {})
+
+
+def register_bus_relist(kind: str) -> None:
+    registry.inc(f"{_NAMESPACE}_bus_relists_total", {"kind": kind})
+
+
+def register_bus_watch_event(kind: str) -> None:
+    registry.inc(f"{_NAMESPACE}_bus_watch_events_total", {"kind": kind})
+
+
+def update_bus_watch_lag(seconds: float) -> None:
+    """Server-stamp → client-dispatch latency of a watch event or
+    bookmark (the wall-clock watch lag operators alert on)."""
+    registry.histogram(
+        f"{_NAMESPACE}_bus_watch_lag_milliseconds", {}
+    ).observe(max(seconds, 0.0) * 1e3)
+
+
+def observe_bus_server_request(op: str, seconds: float, code: str) -> None:
+    registry.inc(f"{_NAMESPACE}_bus_server_requests_total",
+                 {"op": op, "code": code})
+    registry.histogram(
+        f"{_NAMESPACE}_bus_server_request_latency_milliseconds", {"op": op}
+    ).observe(seconds * 1e3)
+
+
+def update_bus_server_watchers(count: int) -> None:
+    registry.set_gauge(f"{_NAMESPACE}_bus_server_watchers", {}, count)
+
+
 # ---- TPU-build additions: per-kernel phase timings ----
 
 def update_kernel_duration(phase: str, seconds: float) -> None:

@@ -8,10 +8,13 @@ stored in a ConfigMap annotation; whoever wins runs the component, and
 a crashed leader's lease expires so a standby takes over and rebuilds
 state from watches.
 
-The standalone equivalent stores the lease in a ConfigMap on the
-in-process API server and uses its resourceVersion compare-and-update
-(the same optimistic concurrency the k8s lock uses) so two candidates
-can never both win a term.
+The standalone equivalent stores the lease in a ConfigMap on the bus —
+the in-process API server, or a remote ``vtpu-apiserver`` through
+``bus.RemoteAPIServer`` (the same interface) — and uses its
+resourceVersion compare-and-update (the same optimistic concurrency the
+k8s lock uses) so two candidates can never both win a term.  Over the
+remote bus the lease arbitrates OS *processes*: SIGKILL the active
+scheduler and a standby in another process takes over after expiry.
 """
 
 from __future__ import annotations
@@ -24,6 +27,7 @@ from typing import Callable, Optional
 from volcano_tpu.apis import core
 from volcano_tpu.client.apiserver import (
     AlreadyExistsError,
+    ApiError,
     APIServer,
     ConflictError,
     NotFoundError,
@@ -67,6 +71,9 @@ class LeaderElector:
         self._stop = threading.Event()
         self._release_on_stop = True
         self._thread: Optional[threading.Thread] = None
+        #: monotonic stamp of the last attempt that successfully renewed
+        #: — is_leader expires against it, see the property
+        self._last_renew = 0.0
 
     # ---- lease record ----
 
@@ -132,13 +139,50 @@ class LeaderElector:
 
     @property
     def is_leader(self) -> bool:
-        return self._leader.is_set()
+        """Leadership, self-expiring against the lease clock.
+
+        The event alone is not enough over a network bus: a renew RPC
+        can block for multiples of the lease duration (degraded link),
+        during which a healthy standby legally acquires the expired
+        lease.  Gating on lease validity here means the old leader's
+        consumers (the daemon work loops check this every cycle) stop
+        acting at the moment the lease lapses — not when the blocked
+        RPC finally returns — so two candidates can never both act as
+        leader."""
+        return (
+            self._leader.is_set()
+            and time.monotonic() - self._last_renew <= self.lease_duration
+        )
 
     def run(self) -> None:
         """Blocking acquire/renew loop (the RunOrDie analogue)."""
         became_leader = False
         while not self._stop.is_set():
-            ok = self._try_acquire_or_renew()
+            # stamp BEFORE the round-trip (client-go semantics): the
+            # lease record's renewTime is written with the pre-call
+            # clock, so judging our own validity from a post-call stamp
+            # would overstate it by the RPC duration — on a congested
+            # bus that is a dual-leadership window
+            attempt_started = time.monotonic()
+            try:
+                ok = self._try_acquire_or_renew()
+                if ok:
+                    self._last_renew = attempt_started
+            except ApiError as e:
+                # A bus outage must not crash the elector thread — and a
+                # single dropped request must not flap leadership: while
+                # the last successful renew is younger than the lease
+                # duration, the lease is still provably ours (no standby
+                # can acquire it), so keep leading and retry.  Only when
+                # renewal keeps failing past the lease's validity do we
+                # step down (client-go leaderelection semantics).
+                log.error("leader election: renew failed for %s: %s",
+                          self.identity, e)
+                ok = (
+                    became_leader
+                    and time.monotonic() - self._last_renew
+                    <= self.lease_duration
+                )
             if ok and not became_leader:
                 became_leader = True
                 self._leader.set()
@@ -154,9 +198,14 @@ class LeaderElector:
             self._stop.wait(self.retry_period)
         # graceful release: zero the lease so a standby takes over fast
         if became_leader and self._release_on_stop:
-            cm, rec = self._read()
-            if cm is not None and rec.get("holderIdentity") == self.identity:
-                self._write(cm, {"holderIdentity": "", "renewTime": 0.0})
+            try:
+                cm, rec = self._read()
+                if cm is not None and rec.get("holderIdentity") == self.identity:
+                    self._write(cm, {"holderIdentity": "", "renewTime": 0.0})
+            except ApiError as e:
+                # bus down at shutdown: the lease simply expires
+                log.error("leader election: release failed for %s: %s",
+                          self.identity, e)
             self._leader.clear()
 
     def start(self) -> "LeaderElector":
