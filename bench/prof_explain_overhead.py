@@ -1,0 +1,160 @@
+"""Explain-mode overhead: jax-allocate action latency with device-derived
+unschedulability explanations off vs on, on the 10k-pod synthetic config
+plus one permanently-unplaceable gang (so the explain path actually
+runs — a fully-placed session computes nothing either way).
+
+Acceptance gate (ISSUE 4): explain-mode warm-cycle overhead must stay
+under 10% of action_ms.  The overhead is the on-device reason-count
+reduction only; two scenarios are measured:
+
+  * warm   — the backlog re-places every cycle (revert_binds protocol,
+             like bench.py's warm action bench).  Placements touch node
+             state, so the stuck tasks take the host predicate sweep in
+             BOTH modes and the on-off delta isolates the reduction.
+  * steady — nothing new places; the stuck gang is the whole session.
+             With explain on, the device proof replaces the O(N) host
+             sweep per stuck task — this mode shows the win, not a cost.
+
+Emits one JSON line per (scenario, mode) plus summary lines, like the
+other bench/prof_*.py scripts.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "bench")
+sys.path.insert(0, ".")
+
+from _profsetup import (  # noqa: E402
+    TIERS,
+    capture_task_infos,
+    make_cache_builder,
+    revert_binds,
+)
+
+from volcano_tpu.actions.jax_allocate import (  # noqa: E402
+    JaxAllocateAction,
+    last_phase_stats,
+)
+from volcano_tpu.apis import core, scheduling  # noqa: E402
+from volcano_tpu.framework import close_session, open_session  # noqa: E402
+
+ITERS = 5
+STUCK_TASKS = 8
+
+fresh = make_cache_builder(n_tasks=10_000, n_nodes=1_000, gang_size=4)
+
+
+def add_stuck_gang(cache) -> None:
+    """One gang whose pods request more cpu than any node allocates —
+    pending forever, explained every cycle."""
+    cache.add_pod_group(
+        scheduling.PodGroup(
+            metadata=core.ObjectMeta(
+                name="pgstuck", namespace="bench", uid="pg-stuck",
+                creation_timestamp=0.0,
+            ),
+            spec=scheduling.PodGroupSpec(
+                min_member=STUCK_TASKS, queue="default", min_resources={},
+            ),
+            status=scheduling.PodGroupStatus(
+                phase=scheduling.POD_GROUP_INQUEUE
+            ),
+        )
+    )
+    for i in range(STUCK_TASKS):
+        cache.add_pod(
+            core.Pod(
+                metadata=core.ObjectMeta(
+                    name=f"stuck-{i}", namespace="bench",
+                    uid=f"pod-stuck-{i}",
+                    annotations={
+                        scheduling.GROUP_NAME_ANNOTATION_KEY: "pgstuck"
+                    },
+                    creation_timestamp=0.0,
+                ),
+                spec=core.PodSpec(
+                    containers=[
+                        core.Container(
+                            name="main",
+                            resources={
+                                "requests": {
+                                    "cpu": "256000m", "memory": "1024Mi",
+                                }
+                            },
+                        )
+                    ],
+                    node_name="", node_selector={}, tolerations=[],
+                    affinity={},
+                ),
+                status=core.PodStatus(phase="Pending"),
+            )
+        )
+
+
+def run_action(cache, action) -> float:
+    """One session through the action; returns action ms."""
+    ssn = open_session(cache, TIERS, [])
+    try:
+        t0 = time.perf_counter()
+        action.execute(ssn)
+        return (time.perf_counter() - t0) * 1e3
+    finally:
+        close_session(ssn)
+
+
+def median(samples) -> float:
+    samples = sorted(samples)
+    return samples[len(samples) // 2]
+
+
+cache = fresh()
+add_stuck_gang(cache)
+orig_tis = capture_task_infos(cache)
+
+# jit warmup (allocate + explain kernels) outside every measurement
+run_action(cache, JaxAllocateAction(explain=True))
+
+results = {}
+for mode, explain in (("off", False), ("on", True)):
+    action = JaxAllocateAction(explain=explain)
+    warm, steady, explain_ms = [], [], []
+    for _ in range(ITERS):
+        revert_binds(cache, orig_tis)
+        warm.append(run_action(cache, action))
+        if explain:
+            explain_ms.append(last_phase_stats.get("explain_ms", 0.0))
+        steady.append(run_action(cache, action))
+    results[("warm", mode)] = median(warm)
+    results[("steady", mode)] = median(steady)
+    for scenario in ("warm", "steady"):
+        print(json.dumps({
+            "metric": "explain_action_latency", "scenario": scenario,
+            "mode": mode, "value": round(results[(scenario, mode)], 3),
+            "unit": "ms",
+        }))
+    if explain and explain_ms:
+        print(json.dumps({
+            "metric": "explain_reduction_latency",
+            "value": round(median(explain_ms), 3), "unit": "ms",
+        }))
+
+warm_off, warm_on = results[("warm", "off")], results[("warm", "on")]
+steady_off, steady_on = results[("steady", "off")], results[("steady", "on")]
+warm_pct = (warm_on - warm_off) / warm_off * 100 if warm_off else 0.0
+print(json.dumps({
+    "metric": "explain_warm_overhead", "value": round(warm_pct, 2),
+    "unit": "%", "budget": 10.0, "pass": warm_pct < 10.0,
+}))
+print(json.dumps({
+    "metric": "explain_steady_delta",
+    "value": round(
+        (steady_on - steady_off) / steady_off * 100 if steady_off else 0.0, 2
+    ),
+    "unit": "%",
+    "note": "negative = explain replaces the host sweep and wins",
+}))
+sys.exit(0 if warm_pct < 10.0 else 1)

@@ -1,0 +1,802 @@
+"""Device-derived scheduling explainability (ISSUE 4).
+
+The acceptance contract: for a snapshot where a task is unschedulable,
+the device-derived ``FitErrors.error()`` message is byte-identical to
+the host path's message on the same snapshot; the synthesized errors
+feed the existing Unschedulable event + pod-condition writeback
+unchanged; and the surfaces (``/explain``, ``vtctl describe``, metrics,
+trace summaries, the bus correlation id) all render from them.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from volcano_tpu.actions.allocate import AllocateAction
+from volcano_tpu.actions.backfill import BackfillAction
+from volcano_tpu.actions.jax_allocate import JaxAllocateAction
+from volcano_tpu.api import FitError, TaskStatus
+from volcano_tpu.api import unschedule_info as reasons
+from volcano_tpu.api.unschedule_info import (
+    FitErrors,
+    format_fit_errors,
+    parse_fit_errors,
+)
+from volcano_tpu.apis import core, scheduling
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.client import APIServer, SchedulerClient
+from volcano_tpu.framework import close_session, open_session
+
+from tests.builders import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+from tests.scheduler_helpers import make_cache, run_actions, tiers
+
+TIERS = tiers(
+    ["priority", "gang", "conformance"],
+    ["drf", "predicates", "proportion", "nodeorder", "binpack"],
+)
+
+
+# ---- unschedule_info unit surface ----
+
+
+class TestFitErrorsFormat:
+    def test_histogram_render_matches_per_node_render(self):
+        per_node = FitErrors()
+        per_node.set_node_error("n1", FitError("t", "n1", reasons.NODE_RESOURCE_FIT_FAILED))
+        per_node.set_node_error("n2", FitError("t", "n2", reasons.NODE_TAINT_UNTOLERATED))
+        per_node.set_node_error("n3", FitError("t", "n3", reasons.NODE_TAINT_UNTOLERATED))
+        synthesized = FitErrors()
+        synthesized.set_histogram(3, {
+            reasons.NODE_RESOURCE_FIT_FAILED: 1,
+            reasons.NODE_TAINT_UNTOLERATED: 2,
+        })
+        assert per_node.error() == synthesized.error()
+        assert per_node.histogram() == synthesized.histogram()
+
+    def test_parse_is_inverse_of_format(self):
+        hist = {
+            reasons.NODE_SELECTOR_MISMATCH: 4,
+            reasons.NODE_POD_NUMBER_EXCEEDED: 2,
+        }
+        msg = format_fit_errors(6, hist)
+        assert parse_fit_errors(msg) == (6, hist)
+
+    def test_parse_rejects_non_aggregate_messages(self):
+        assert parse_fit_errors("pod group is not ready, 3 Pending.") is None
+        assert parse_fit_errors("") is None
+
+
+# ---- the equivalence acceptance criterion ----
+
+
+def _mixed_reason_objects():
+    """One stuck task vs five nodes, each failing a DIFFERENT first
+    predicate in host order: resource fit, pod count, unschedulable,
+    selector, taint."""
+    nodes = [
+        # too small → resource fit (checked before everything else)
+        build_node("n-small", {"cpu": "1", "memory": "1Gi"},
+                   labels={"accel": "tpu"}),
+        # roomy but zero pod slots → pod number exceeded
+        build_node("n-full", {"cpu": "32", "memory": "32Gi", "pods": 0},
+                   labels={"accel": "tpu"}),
+        # cordoned → unschedulable
+        build_node("n-cordon", {"cpu": "32", "memory": "32Gi"},
+                   labels={"accel": "tpu"}, unschedulable=True),
+        # missing the selector label → selector mismatch
+        build_node("n-other", {"cpu": "32", "memory": "32Gi"}),
+        # labeled but tainted → taint untolerated
+        build_node(
+            "n-taint", {"cpu": "32", "memory": "32Gi"},
+            labels={"accel": "tpu"},
+            taints=[core.Taint(key="dedicated", value="x",
+                               effect="NoSchedule")],
+        ),
+    ]
+    pods = [
+        build_pod("ns", "stuck-0", "", {"cpu": "4", "memory": "4Gi"},
+                  group="pg-stuck", selector={"accel": "tpu"}),
+    ]
+    pgs = [build_pod_group("ns", "pg-stuck", 1, queue="q1")]
+    queues = [build_queue("q1", weight=1)]
+    return nodes, pods, pgs, queues
+
+
+def _fit_error_map(ssn):
+    """(namespace/name) → (message, was_synthesized) over all jobs."""
+    out = {}
+    for job in ssn.jobs.values():
+        for uid, fe in job.nodes_fit_errors.items():
+            task = job.tasks[uid]
+            out[f"{task.namespace}/{task.name}"] = (
+                fe.error(), fe._histogram is not None
+            )
+    return out
+
+
+def _run_capture(cache, actions, tier_conf):
+    """Run the actions and capture the fit-error map BEFORE close_session
+    empties the session maps."""
+    ssn = open_session(cache, tier_conf, [])
+    try:
+        for action in actions:
+            action.execute(ssn)
+        return _fit_error_map(ssn)
+    finally:
+        close_session(ssn)
+
+
+class TestDeviceHostEquivalence:
+    def test_mixed_reasons_byte_identical(self):
+        """The acceptance pin: five nodes, five distinct first-failure
+        reasons — the device-synthesized message equals the host sweep's
+        byte for byte, and the device path really synthesized (no host
+        sweep ran for it)."""
+        host = _run_capture(
+            make_cache(*_mixed_reason_objects()), [AllocateAction()], TIERS
+        )
+        dev = _run_capture(
+            make_cache(*_mixed_reason_objects()),
+            [JaxAllocateAction(explain=True)],
+            TIERS,
+        )
+
+        assert set(host) == set(dev) == {"ns/stuck-0"}
+        host_msg, host_synth = host["ns/stuck-0"]
+        dev_msg, dev_synth = dev["ns/stuck-0"]
+        assert not host_synth and dev_synth
+        assert dev_msg == host_msg
+        # every reason plane shows up exactly once
+        total, hist = parse_fit_errors(dev_msg)
+        assert total == 5
+        assert hist == {
+            reasons.NODE_RESOURCE_FIT_FAILED: 1,
+            reasons.NODE_POD_NUMBER_EXCEEDED: 1,
+            reasons.NODE_UNSCHEDULABLE: 1,
+            reasons.NODE_SELECTOR_MISMATCH: 1,
+            reasons.NODE_TAINT_UNTOLERATED: 1,
+        }
+
+    def test_randomized_stuck_cluster_equivalence(self):
+        """Label/taint-rich synthetic cluster where nothing fits: the
+        device path's recorded messages equal the host path's for every
+        task, across many tasks and mixed reasons."""
+        from volcano_tpu.ops.synthetic import generate_cluster_objects
+
+        def fresh():
+            nodes, pods, pgs, queues = generate_cluster_objects(
+                n_tasks=48, n_nodes=12, gang_size=4, seed=3,
+                label_classes=3, taint_fraction=0.4,
+                node_cpu_milli=100, node_mem_mib=64,  # nothing ever fits
+            )
+            cache = make_cache(nodes=nodes, pods=pods, pod_groups=pgs,
+                               queues=queues)
+            return cache
+
+        host = _run_capture(fresh(), [AllocateAction()], TIERS)
+        dev = _run_capture(fresh(), [JaxAllocateAction(explain=True)], TIERS)
+        assert host and set(host) == set(dev)
+        synthesized = 0
+        for key, (host_msg, _) in host.items():
+            dev_msg, dev_synth = dev[key]
+            assert dev_msg == host_msg, key
+            synthesized += dev_synth
+        # tasks the ORDER replay pruned (the tiny queue goes overused
+        # mid-replay) aren't in the packed session and correctly take
+        # the host sweep; the in-session ones must have synthesized
+        assert synthesized >= 1
+
+    def test_explain_off_still_records_via_host_sweep(self):
+        fe_map = _run_capture(
+            make_cache(*_mixed_reason_objects()),
+            [JaxAllocateAction(explain=False)],
+            TIERS,
+        )
+        msg, synth = fe_map["ns/stuck-0"]
+        assert not synth and "0/5 nodes are available" in msg
+
+    def test_synthesis_refused_after_placements_still_correct(self):
+        """A placeable job ahead of the stuck one: placements touch node
+        state, the synthesis gate closes, and the stuck task takes the
+        host sweep — message still present and well-formed."""
+        nodes, pods, pgs, queues = _mixed_reason_objects()
+        pods = pods + [
+            build_pod("ns", "easy-0", "", {"cpu": "1", "memory": "1Gi"},
+                      group="pg-easy"),
+        ]
+        pgs = pgs + [build_pod_group("ns", "pg-easy", 1, queue="q1")]
+        cache = make_cache(nodes, pods, pgs, queues)
+        fe_map = _run_capture(cache, [JaxAllocateAction(explain=True)], TIERS)
+        assert cache.binder.binds  # the easy pod placed
+        msg, synth = fe_map["ns/stuck-0"]
+        assert not synth  # gate closed — host sweep ran
+        assert parse_fit_errors(msg) is not None
+
+    def test_plane_retention_attributes_per_node(self):
+        ssn = run_actions(
+            make_cache(*_mixed_reason_objects()),
+            [JaxAllocateAction(explain=True, explain_planes=True)],
+            TIERS,
+        )
+        from volcano_tpu.ops.explain import last_explain
+
+        info = last_explain()
+        assert info is not None and len(info["tasks"]) == 1
+        (detail,) = info["tasks"].values()
+        assert detail["nodes"] == {
+            "n-small": reasons.NODE_RESOURCE_FIT_FAILED,
+            "n-full": reasons.NODE_POD_NUMBER_EXCEEDED,
+            "n-cordon": reasons.NODE_UNSCHEDULABLE,
+            "n-other": reasons.NODE_SELECTOR_MISMATCH,
+            "n-taint": reasons.NODE_TAINT_UNTOLERATED,
+        }
+
+    def test_pressure_predicates_close_the_synthesis_gate(self):
+        """Opt-in pressure predicates insert host failure reasons the
+        device planes cannot see — synthesis must refuse and take the
+        host sweep (still correct messages, just not device-derived)."""
+        from volcano_tpu.conf import PluginOption, Tier
+        from volcano_tpu.framework.arguments import Arguments
+
+        pressure_tiers = [
+            Tier(plugins=[
+                PluginOption(name=n)
+                for n in ("priority", "gang", "conformance")
+            ]),
+            Tier(plugins=[
+                PluginOption(
+                    name="predicates",
+                    arguments=Arguments(
+                        {"predicate.MemoryPressureEnable": "true"}
+                    ),
+                ),
+                *[PluginOption(name=n)
+                  for n in ("drf", "proportion", "nodeorder", "binpack")],
+            ]),
+        ]
+        fe_map = _run_capture(
+            make_cache(*_mixed_reason_objects()),
+            [JaxAllocateAction(explain=True)],
+            pressure_tiers,
+        )
+        msg, synth = fe_map["ns/stuck-0"]
+        assert not synth  # gate closed
+        assert parse_fit_errors(msg) is not None
+
+    def test_stale_last_explain_cleared(self):
+        """A later cycle with nothing to explain clears the /explain
+        surface instead of serving the previous cycle's explanation."""
+        from volcano_tpu.ops.explain import last_explain
+
+        run_actions(
+            make_cache(*_mixed_reason_objects()),
+            [JaxAllocateAction(explain=True)],
+            TIERS,
+        )
+        assert last_explain() is not None
+        # a cycle where everything places
+        run_actions(
+            make_cache(
+                nodes=[build_node("n1", {"cpu": "8", "memory": "8Gi"})],
+                pods=[build_pod("ns", "easy-0", "",
+                                {"cpu": "1", "memory": "1Gi"}, group="pg1")],
+                pod_groups=[build_pod_group("ns", "pg1", 1, queue="q1")],
+                queues=[build_queue("q1", weight=1)],
+            ),
+            [JaxAllocateAction(explain=True)],
+            TIERS,
+        )
+        assert last_explain() is None
+
+    def test_reason_metric_label_cardinality_bounded(self):
+        from volcano_tpu.metrics import metrics
+
+        metrics.registry.reset()
+        metrics.register_unschedulable_reason(
+            'persistentvolumeclaim "ns/claim-42" not found'
+        )
+        metrics.register_unschedulable_reason(
+            'persistentvolumeclaim "ns/claim-43" not found'
+        )
+        metrics.register_unschedulable_reason(reasons.NODE_NOT_READY)
+        text = metrics.registry.render()
+        assert 'volcano_unschedulable_task_reasons{reason="other"} 2' in text
+        assert "claim-42" not in text
+
+    def test_unschedulable_reason_metric_recorded(self):
+        from volcano_tpu.metrics import metrics
+
+        metrics.registry.reset()
+        run_actions(
+            make_cache(*_mixed_reason_objects()),
+            [JaxAllocateAction(explain=True)],
+            TIERS,
+        )
+        text = metrics.registry.render()
+        assert (
+            'volcano_unschedulable_task_reasons{reason="'
+            + reasons.NODE_TAINT_UNTOLERATED + '"} 1'
+        ) in text
+        assert "volcano_explain_latency_milliseconds_count" in text
+
+
+# ---- no-victim preempt/reclaim explanations ----
+
+
+class TestNoVictimExplain:
+    def test_jax_preempt_no_victim_synthesizes(self):
+        from volcano_tpu.actions.jax_preempt import JaxPreemptAction
+
+        cache = make_cache(
+            nodes=[build_node("n1", {"cpu": "4", "memory": "4Gi"})],
+            pods=[
+                build_pod("ns", "victim", "n1", {"cpu": "2", "memory": "2Gi"},
+                          phase="Running", group="pg1", priority=0),
+                # can never fit, even with every victim evicted
+                build_pod("ns", "preemptor", "", {"cpu": "8", "memory": "2Gi"},
+                          group="pg2", priority=10),
+            ],
+            pod_groups=[
+                build_pod_group("ns", "pg1", 1, queue="q1"),
+                build_pod_group("ns", "pg2", 1, queue="q1"),
+            ],
+            queues=[build_queue("q1", weight=1)],
+        )
+        fe_map = _run_capture(cache, [JaxPreemptAction()], TIERS)
+        assert cache.evictor.evicts == []
+        msg, synth = fe_map["ns/preemptor"]
+        assert synth
+        assert msg == format_fit_errors(
+            1, {reasons.NODE_RESOURCE_FIT_FAILED: 1}
+        )
+
+
+# ---- events + pod conditions writeback (satellite 3) ----
+
+
+def _writeback_cluster():
+    """Cache wired to a real API server so the status writeback records
+    Events and pod conditions; one tainted node, one intolerant task."""
+    api = APIServer()
+    node = build_node(
+        "n1", {"cpu": "8", "memory": "8Gi"},
+        taints=[core.Taint(key="dedicated", value="x", effect="NoSchedule")],
+    )
+    pod = build_pod("ns", "pg1-stuck-0", "",
+                    {"cpu": "1", "memory": "1Gi"}, group="pg1")
+    pg = build_pod_group("ns", "pg1", 1, queue="q1")
+    queue = build_queue("q1", weight=1)
+    for obj in (node, pod, pg, queue):
+        api.create(obj)
+    cache = SchedulerCache(client=SchedulerClient(api))
+    cache.add_node(node)
+    cache.add_pod(pod)
+    cache.add_pod_group(pg)
+    cache.add_queue(queue)
+    return api, cache
+
+
+EXPECTED_TAINT_MESSAGE = format_fit_errors(
+    1, {reasons.NODE_TAINT_UNTOLERATED: 1}
+)
+
+
+class TestUnschedulableWriteback:
+    @pytest.mark.parametrize("action_cls", [AllocateAction, JaxAllocateAction])
+    def test_one_event_and_condition_per_cycle(self, action_cls):
+        api, cache = _writeback_cluster()
+        run_actions(cache, [action_cls()], TIERS)
+
+        events = [
+            e for e in api.list("Event", "ns")
+            if e.reason == "Unschedulable"
+        ]
+        assert len(events) == 1
+        (ev,) = events
+        assert ev.type == "Warning" and ev.count == 1
+        assert ev.message == EXPECTED_TAINT_MESSAGE
+        assert ev.involved_object["name"] == "pg1-stuck-0"
+
+        pod = api.get("Pod", "ns", "pg1-stuck-0")
+        conds = [c for c in pod.status.conditions if c.type == "PodScheduled"]
+        assert len(conds) == 1
+        assert conds[0].status == "False"
+        assert conds[0].reason == "Unschedulable"
+        assert conds[0].message == EXPECTED_TAINT_MESSAGE
+
+        # a second identical stuck cycle must NOT duplicate anything:
+        # the pod-group status is unchanged, so the writeback gate
+        # (is_pod_group_status_updated) suppresses a re-record — still
+        # exactly one Event row, count untouched, one condition
+        run_actions(cache, [action_cls()], TIERS)
+        events = [
+            e for e in api.list("Event", "ns")
+            if e.reason == "Unschedulable"
+        ]
+        assert len(events) == 1 and events[0].count == 1
+        pod = api.get("Pod", "ns", "pg1-stuck-0")
+        assert len([c for c in pod.status.conditions
+                    if c.type == "PodScheduled"]) == 1
+
+    def test_unschedulable_digest_parked_and_cleared(self):
+        api, cache = _writeback_cluster()
+        run_actions(cache, [AllocateAction()], TIERS)
+        assert len(cache.unschedulable_digest) == 1
+        (digest,) = cache.unschedulable_digest.values()
+        assert digest["name"] == "pg1" and digest["namespace"] == "ns"
+        (task,) = digest["tasks"].values()
+        assert task["message"] == EXPECTED_TAINT_MESSAGE
+
+        # untaint the node → task schedules → digest clears
+        node = build_node("n2", {"cpu": "8", "memory": "8Gi"})
+        api.create(node)
+        cache.add_node(node)
+        run_actions(cache, [AllocateAction()], TIERS)
+        assert cache.unschedulable_digest == {}
+
+
+# ---- cache event client handling (satellite 2) ----
+
+
+class TestRecordEventClients:
+    def test_remote_api_server_records_events_over_bus(self):
+        from volcano_tpu.bus import BusServer, RemoteAPIServer
+
+        api = APIServer()
+        server = BusServer(api).start()
+        client = RemoteAPIServer(f"tcp://127.0.0.1:{server.port}", timeout=5)
+        try:
+            assert client.wait_ready(5)
+            involved = {"kind": "Pod", "namespace": "ns", "name": "p1"}
+            client.record_event("ns", involved, "Warning", "Unschedulable", "m1")
+            client.record_event("ns", involved, "Warning", "Unschedulable", "m2")
+            events = api.list("Event", "ns")
+            assert len(events) == 1
+            assert events[0].count == 2 and events[0].message == "m2"
+
+            # the cache path accepts a bare RemoteAPIServer as client
+            cache = SchedulerCache(client=client)
+            task = next(
+                iter(
+                    make_cache(
+                        pods=[build_pod("ns", "p1", "", {"cpu": "1"},
+                                        group="pg1")],
+                        pod_groups=[build_pod_group("ns", "pg1", 1)],
+                    ).jobs.values()
+                )
+            ).tasks
+            cache._record_event(
+                next(iter(task.values())), "Warning", "FailedScheduling", "x"
+            )
+            assert any(
+                e.reason == "FailedScheduling" for e in api.list("Event", "ns")
+            )
+        finally:
+            client.close()
+            server.stop()
+
+    def test_capability_less_client_warns_once(self, caplog):
+        class NoEvents:
+            pass
+
+        cache = SchedulerCache(client=NoEvents())
+        pod = build_pod("ns", "p1", "", {"cpu": "1"}, group="pg1")
+        cache.add_pod(pod)
+        task = next(iter(next(iter(cache.jobs.values())).tasks.values()))
+        with caplog.at_level("WARNING"):
+            cache._record_event(task, "Warning", "Unschedulable", "m")
+            cache._record_event(task, "Warning", "Unschedulable", "m")
+        warnings = [
+            r for r in caplog.records if "cannot record events" in r.message
+        ]
+        assert len(warnings) == 1
+
+
+# ---- backfill reason propagation (satellite 1) ----
+
+
+class TestBackfillReasons:
+    def test_allocate_fit_error_keeps_bare_reasons(self, monkeypatch):
+        cache = make_cache(
+            nodes=[build_node("n1", {"cpu": "2", "memory": "2Gi"})],
+            pods=[build_pod("ns", "be-0", "", {}, group="pg1")],
+            pod_groups=[build_pod_group("ns", "pg1", 1, queue="q1")],
+            queues=[build_queue("q1", weight=1)],
+        )
+        ssn = open_session(cache, TIERS, [])
+        try:
+            def boom(task, hostname):
+                raise FitError(task, ssn.nodes[hostname],
+                               reasons.NODE_PORT_CONFLICT)
+
+            monkeypatch.setattr(ssn, "allocate", boom)
+            BackfillAction().execute(ssn)
+            (job,) = [j for j in ssn.jobs.values() if j.nodes_fit_errors]
+            (fe,) = job.nodes_fit_errors.values()
+            # the bare reason — not "task X on node Y: ..." — lands in
+            # the histogram
+            assert fe.histogram() == {reasons.NODE_PORT_CONFLICT: 1}
+            assert fe.error() == format_fit_errors(
+                1, {reasons.NODE_PORT_CONFLICT: 1}
+            )
+        finally:
+            close_session(ssn)
+
+
+# ---- executor / compute-plane plumbing ----
+
+
+class TestExplainPlumbing:
+    def _stuck_snapshot(self):
+        from volcano_tpu.ops.synthetic import generate_snapshot
+
+        snap = generate_snapshot(n_tasks=32, n_nodes=8, gang_size=4, seed=5)
+        snap.task_resreq[:, 0] = 1e9  # nothing fits anywhere
+        return snap
+
+    def test_executor_counts_lazy(self):
+        from volcano_tpu.ops import executor
+        from volcano_tpu.ops.synthetic import generate_snapshot
+
+        executor.configure(None)
+        placed = generate_snapshot(n_tasks=16, n_nodes=8, gang_size=4, seed=0)
+        executor.execute_allocate(placed, explain=True)
+        assert executor.last_explain_counts() is None  # everything placed
+
+        snap = self._stuck_snapshot()
+        executor.execute_allocate(snap, explain=True)
+        counts = executor.last_explain_counts()
+        assert counts is not None and counts.shape == (snap.n_tasks, 5)
+        assert (counts.sum(axis=1) == snap.n_nodes).all()
+
+    def test_compute_plane_returns_reason_counts(self, tmp_path):
+        from volcano_tpu.ops.explain import run_explain
+        from volcano_tpu.serving.compute_plane import (
+            ComputePlaneClient,
+            ComputePlaneServer,
+        )
+
+        path = str(tmp_path / "cp.sock")
+        server = ComputePlaneServer(path).start()
+        try:
+            client = ComputePlaneClient(path, timeout=60)
+            snap = self._stuck_snapshot()
+            assignment = client.allocate(snap, explain=True)
+            assert (assignment[: snap.n_tasks] < 0).all()
+            remote_counts = client.last_reason_counts
+            assert remote_counts is not None
+            unplaced = np.arange(snap.n_tasks)
+            local = run_explain(snap, task_rows=unplaced).counts
+            assert np.array_equal(remote_counts, local)
+
+            # without the flag the response carries no counts
+            client.allocate(snap, explain=False)
+            assert client.last_reason_counts is None
+        finally:
+            server.stop()
+
+    def test_task_row_subset_matches_full(self):
+        from volcano_tpu.ops.explain import run_explain
+        from volcano_tpu.ops.synthetic import generate_snapshot
+
+        snap = generate_snapshot(n_tasks=32, n_nodes=8, gang_size=4, seed=7)
+        snap.task_resreq[::3, 0] = 1e9
+        full = run_explain(snap)
+        rows = np.arange(0, snap.n_tasks, 3)
+        subset = run_explain(snap, task_rows=rows)
+        assert np.array_equal(full.counts[rows], subset.counts[rows])
+        off_rows = np.setdiff1d(np.arange(snap.n_tasks), rows)
+        assert (subset.counts[off_rows] == 0).all()
+
+
+# ---- /explain endpoint ----
+
+
+class TestExplainEndpoint:
+    def test_endpoint_serves_digest(self):
+        from volcano_tpu.serving.explain import explain_jobs
+        from volcano_tpu.serving.http import ServingServer
+
+        api, cache = _writeback_cluster()
+        run_actions(cache, [JaxAllocateAction(explain=True)], TIERS)
+
+        server = ServingServer(
+            port=0,
+            explain_source=lambda ns, job: explain_jobs(cache, ns, job),
+        ).start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/explain"
+            ) as resp:
+                data = json.loads(resp.read())
+            assert len(data["jobs"]) == 1
+            (job,) = data["jobs"]
+            assert job["name"] == "pg1"
+            (task,) = job["unschedulable"]
+            assert task["message"] == EXPECTED_TAINT_MESSAGE
+            assert task["reasons"] == {reasons.NODE_TAINT_UNTOLERATED: 1}
+            assert data["last_cycle"]["reasons"] == {
+                reasons.NODE_TAINT_UNTOLERATED: 1
+            }
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/explain?namespace=ns&job=pg1"
+            ) as resp:
+                assert json.loads(resp.read())["jobs"]
+
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/explain?job=missing"
+                )
+            assert e.value.code == 404
+        finally:
+            server.stop()
+
+    def test_endpoint_404_without_source(self):
+        from volcano_tpu.serving.http import ServingServer
+
+        server = ServingServer(port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/explain"
+                )
+            assert e.value.code == 404
+        finally:
+            server.stop()
+
+
+# ---- vtctl describe over both backends (acceptance) ----
+
+
+def _scheduled_api_with_stuck_job():
+    """Drive a REAL scheduling cycle against an API server so the
+    Unschedulable event/condition/podgroup-condition exist, then
+    describe through it."""
+    from volcano_tpu.apis import batch
+
+    api, cache = _writeback_cluster()
+    # a vcjob whose name matches the podgroup, as the job controller
+    # lays them out, so `describe job` joins them
+    job = batch.Job(
+        metadata=core.ObjectMeta(name="pg1", namespace="ns"),
+        spec=batch.JobSpec(
+            min_available=1, queue="q1",
+            tasks=[batch.TaskSpec(name="stuck", replicas=1)],
+        ),
+    )
+    api.create(job)
+    run_actions(cache, [JaxAllocateAction(explain=True)], TIERS)
+    return api
+
+
+class TestVtctlDescribe:
+    def _run(self, argv, api):
+        import io
+
+        from volcano_tpu.cli.vtctl import main
+
+        out = io.StringIO()
+        rc = main(argv, api=api, out=out)
+        return rc, out.getvalue()
+
+    def test_describe_podgroup_in_process(self):
+        api = _scheduled_api_with_stuck_job()
+        rc, text = self._run(
+            ["describe", "podgroup", "-N", "pg1", "-n", "ns"], api
+        )
+        assert rc == 0
+        assert "Unschedulable" in text
+        assert EXPECTED_TAINT_MESSAGE in text
+        assert f"1       {reasons.NODE_TAINT_UNTOLERATED}" in text
+
+    def test_describe_job_both_backends(self):
+        from volcano_tpu.bus import BusServer
+
+        api = _scheduled_api_with_stuck_job()
+        rc, local = self._run(["describe", "job", "-N", "pg1", "-n", "ns"], api)
+        assert rc == 0
+        assert "Unschedulable" in local  # the Event row
+        assert EXPECTED_TAINT_MESSAGE in local
+
+        server = BusServer(api).start()
+        try:
+            from volcano_tpu.cli.vtctl import main
+
+            import io
+
+            out = io.StringIO()
+            rc = main(
+                ["--bus", f"tcp://127.0.0.1:{server.port}",
+                 "describe", "job", "-N", "pg1", "-n", "ns"],
+                out=out,
+            )
+            remote = out.getvalue()
+            assert rc == 0
+            assert remote == local  # byte-identical over the bus
+        finally:
+            server.stop()
+
+    def test_describe_missing(self):
+        rc, text = self._run(
+            ["describe", "job", "-N", "nope", "-n", "ns"], APIServer()
+        )
+        assert rc == 1 and "not found" in text
+
+
+# ---- trace journal + cross-process correlation ----
+
+
+class TestExplainTrace:
+    def test_explain_summary_journaled(self, tmp_path):
+        from volcano_tpu import trace
+
+        rec = trace.enable(str(tmp_path / "journal"), snapshot_every=0)
+        try:
+            cid = rec.begin_cycle()
+            run_actions(
+                make_cache(*_mixed_reason_objects()),
+                [JaxAllocateAction(explain=True)],
+                TIERS,
+            )
+            rec.end_cycle(duration_s=0.01)
+            record = rec.journal.read_cycle(cid)
+            (summary,) = [
+                e for e in record["events"] if e["name"] == "explain-summary"
+            ]
+            assert summary["args"]["tasks"] == 1
+            assert summary["args"]["reasons"] == {
+                reasons.NODE_RESOURCE_FIT_FAILED: 1,
+                reasons.NODE_POD_NUMBER_EXCEEDED: 1,
+                reasons.NODE_UNSCHEDULABLE: 1,
+                reasons.NODE_SELECTOR_MISMATCH: 1,
+                reasons.NODE_TAINT_UNTOLERATED: 1,
+            }
+        finally:
+            trace.disable()
+
+    def test_scheduler_sets_cycle_correlation_id(self):
+        from volcano_tpu import trace
+        from volcano_tpu.scheduler.scheduler import Scheduler
+
+        cache = make_cache(queues=[build_queue("q1", weight=1)])
+        sched = Scheduler(cache)
+        sched.run_once()
+        first = trace.current_cycle()
+        sched.run_once()
+        assert trace.current_cycle() == first + 1
+
+    def test_bus_request_carries_cycle_id(self):
+        from volcano_tpu import trace
+        from volcano_tpu.bus import BusServer, RemoteAPIServer
+
+        api = APIServer()
+        server = BusServer(api).start()
+        client = RemoteAPIServer(f"tcp://127.0.0.1:{server.port}", timeout=5)
+        rec = trace.TraceRecorder()
+        trace.set_recorder(rec)
+        try:
+            assert client.wait_ready(5)
+            trace.set_current_cycle(41)
+            rec.begin_cycle()
+            client.create(build_queue("qx", weight=1))
+            rec.end_cycle()
+            events = [
+                e for e in rec.last_cycle()["events"]
+                if e["name"] == "bus:create"
+            ]
+            assert events and events[0]["args"]["cycle"] == 41
+        finally:
+            trace.set_current_cycle(-1)
+            trace.disable()
+            client.close()
+            server.stop()

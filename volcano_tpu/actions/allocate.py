@@ -19,6 +19,7 @@ from volcano_tpu.api import unschedule_info as reasons
 from volcano_tpu.apis import scheduling
 from volcano_tpu.framework.interface import Action
 from volcano_tpu.framework.session import Session
+from volcano_tpu.metrics import metrics
 from volcano_tpu.scheduler import util as sched_util
 from volcano_tpu.utils.priority_queue import PriorityQueue
 
@@ -144,6 +145,8 @@ def host_node_chooser(ssn: Session):
         )
         if not predicate_nodes:
             job.nodes_fit_errors[task.uid] = fit_errors
+            for reason in fit_errors.histogram():
+                metrics.register_unschedulable_reason(reason)
             return None
         node_scores = sched_util.prioritize_nodes(
             task,

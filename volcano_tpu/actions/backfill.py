@@ -45,6 +45,15 @@ class BackfillAction(Action):
                         continue
                     try:
                         ssn.allocate(task, node.name)
+                    except FitError as err:
+                        # propagate the bare reasons — re-wrapping str(err)
+                        # would stuff the whole "task X on node Y: reason"
+                        # line into the list and corrupt the
+                        # FitErrors.error() reason histogram
+                        fe.set_node_error(
+                            node.name, FitError(task, node, *err.reasons)
+                        )
+                        continue
                     except Exception as err:  # noqa: BLE001 — try next node
                         fe.set_node_error(node.name, FitError(task, node, str(err)))
                         continue

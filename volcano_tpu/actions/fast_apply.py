@@ -382,6 +382,7 @@ def try_fast_apply(
     if hasattr(ssn, "touched_jobs"):
         ssn.touched_jobs.update(job_accs)
         ssn.touched_nodes.update(node_rows)
+        ssn.node_state_epoch += 1
     return len(bulk) == len(ordered)
 
 

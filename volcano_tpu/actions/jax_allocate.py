@@ -33,6 +33,7 @@ keeps the result valid — semantics never degrade below the host action.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -50,6 +51,79 @@ from volcano_tpu.metrics import metrics
 from volcano_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+
+class _ExplainContext:
+    """Device-derived unschedulability explanations for one session.
+
+    Built from the reason-count matrix the executor returned alongside
+    the assignment (ops/explain).  ``try_explain`` replaces the host
+    fallback's O(N) predicate sweep for a task the device PROVED fits no
+    node — synthesizing the reference-identical FitErrors from the
+    counts — under gates that keep the messages byte-faithful to what
+    the host path would have recorded on the same snapshot:
+
+      * the predicates plugin is in the session, with no opt-in
+        pressure predicates (ops.explain.session_explain_compatible —
+        the host chain's pressure checks have no device plane);
+      * the task row is bitset-exact and memory-exact
+        (ops.explain.task_exactly_encoded);
+      * no placement has mutated node state since the pack — after a
+        placement the host's pop-time first-failure can shift to a
+        resource-fit failure the snapshot-time counts predate, so those
+        tasks take the host sweep instead.
+    """
+
+    def __init__(self, ssn: Session, snap, counts, ordered, nodes,
+                 planes=None):
+        from volcano_tpu.ops.explain import (
+            ExplainResult,
+            session_explain_compatible,
+        )
+
+        self.ssn = ssn
+        self.snap = snap
+        self.result = ExplainResult(counts, snap.n_nodes, planes)
+        self.index = {t.uid: i for i, t in enumerate(ordered)}
+        self.node_names = [n.name for n in nodes]
+        self.enabled = session_explain_compatible(ssn)
+        #: node-state epoch at pack time — any later mutation (even of a
+        #: node some earlier action already touched) advances it, so the
+        #: gate below cannot be fooled by repeat mutations the
+        #: touched-SET would deduplicate away
+        self._epoch0 = ssn.node_state_epoch
+        #: task uid → reason histogram, for the cycle summary
+        self.explained: Dict[str, Dict[str, int]] = {}
+
+    def try_explain(self, task: TaskInfo):
+        """FitErrors for ``task`` when the device counts prove it
+        unschedulable everywhere — None sends the caller to the host
+        sweep."""
+        from volcano_tpu.ops.explain import task_exactly_encoded
+
+        if not self.enabled or self.ssn.node_state_epoch != self._epoch0:
+            return None
+        i = self.index.get(task.uid)
+        if i is None or i >= len(self.result.counts):
+            return None
+        if not task_exactly_encoded(self.snap, i):
+            return None
+        if not self.result.all_infeasible(i):
+            return None
+        hist = self.result.histogram(i)
+        self.explained[task.uid] = hist
+        for reason in hist:
+            metrics.register_unschedulable_reason(reason)
+        return self.result.fit_errors(i)
+
+    def summary(self) -> Dict[str, int]:
+        """Aggregate reason → node-count histogram over the explained
+        tasks (the per-cycle trace journal record)."""
+        agg: Dict[str, int] = {}
+        for hist in self.explained.values():
+            for reason, count in hist.items():
+                agg[reason] = agg.get(reason, 0) + count
+        return agg
 
 
 def compute_task_order(ssn: Session) -> List[TaskInfo]:
@@ -107,11 +181,32 @@ last_phase_stats: Dict[str, float] = {}
 
 
 class JaxAllocateAction(Action):
-    def __init__(self, weights=None, gang_rounds: int = 3):
+    def __init__(
+        self,
+        weights=None,
+        gang_rounds: int = 3,
+        explain: Optional[bool] = None,
+        explain_planes: Optional[bool] = None,
+    ):
         from volcano_tpu.ops.kernels import DEFAULT_WEIGHTS
 
         self.weights = weights or DEFAULT_WEIGHTS
         self.gang_rounds = gang_rounds
+        from volcano_tpu.ops.explain import explain_enabled
+
+        #: device-derived unschedulability explanations (ops/explain).
+        #: On by default: the reason-count reduction only runs when a
+        #: task went unplaced, so fully-placed warm cycles pay nothing.
+        #: VTPU_NO_EXPLAIN=1 (or explain=False) turns it off.
+        self.explain = explain_enabled() if explain is None else explain
+        #: additionally retain the per-pair [T, N] reason plane for the
+        #: /explain endpoint's node-level attribution.  Off by default —
+        #: the retention transfer scales with T×N, the counts with T×5.
+        self.explain_planes = (
+            bool(os.environ.get("VTPU_EXPLAIN_PLANES"))
+            if explain_planes is None
+            else explain_planes
+        )
 
     def name(self) -> str:
         return "jax-allocate"
@@ -180,9 +275,13 @@ class JaxAllocateAction(Action):
         t0 = time.perf_counter()
         # executor indirection: in-process kernels, or the compute-plane
         # sidecar when VTPU_COMPUTE_PLANE is configured (with automatic
-        # in-process fallback when the sidecar is down)
+        # in-process fallback when the sidecar is down).  explain=True
+        # makes the executor return the reason-count matrix alongside
+        # the assignment when tasks went unplaced (lazy — a fully-placed
+        # session computes nothing extra).
         assignment = execute_allocate(
-            snap, weights=self.weights, gang_rounds=self.gang_rounds
+            snap, weights=self.weights, gang_rounds=self.gang_rounds,
+            explain=self.explain,
         )
         metrics.update_kernel_duration("execute", time.perf_counter() - t0)
 
@@ -252,9 +351,53 @@ class JaxAllocateAction(Action):
             # the window the staged transfer had to overlap host work
             last_phase_stats["relay_overlap_ms"] = order_s * 1e3
         if not ordered:
+            if self.explain:
+                # nothing pending → nothing to explain; clear the
+                # surface so /explain never serves a previous cycle
+                self._publish_explain(ssn, None)
             return
         proposals, snap = self._kernel_proposals(ssn, ordered, nodes, pc)
 
+        # Reason counts the executor produced for unplaced tasks — the
+        # device-derived "why pending" source (ops/explain).
+        explain_ctx = None
+        if self.explain and snap is not None:
+            from volcano_tpu.ops import executor as _executor
+            from volcano_tpu.ops import explain as _explain
+
+            counts = _executor.last_explain_counts()
+            if counts is not None:
+                planes = None
+                if self.explain_planes:
+                    # node-level attribution for the /explain surface;
+                    # recomputed locally (the wire ships counts only)
+                    # over the rows that recorded any infeasibility
+                    import numpy as _np
+
+                    planes = _explain.run_explain(
+                        snap, retain_planes=True,
+                        task_rows=_np.nonzero(counts.sum(axis=1) > 0)[0],
+                    ).reasons
+                explain_ctx = _ExplainContext(
+                    ssn, snap, counts, ordered, nodes, planes=planes
+                )
+                # None when the sidecar reduced the counts — its own
+                # metrics carry that cost; don't fabricate a local one
+                explain_ms = _executor.last_explain_ms()
+                if explain_ms is not None:
+                    last_phase_stats["explain_ms"] = explain_ms
+
+        try:
+            self._apply(ssn, ordered, proposals, snap, explain_ctx)
+        finally:
+            if self.explain:
+                # also clears: a cycle that explained nothing (all
+                # placed, gate closed, or no packed session) must not
+                # leave the /explain surface serving a previous cycle's
+                # explanation as current
+                self._publish_explain(ssn, explain_ctx)
+
+    def _apply(self, ssn, ordered, proposals, snap, explain_ctx) -> None:
         # Fully-placed exact sessions commit in bulk (actions/fast_apply);
         # anything outside that envelope runs the loop below.
         if snap is not None:
@@ -277,6 +420,14 @@ class JaxAllocateAction(Action):
                         return node
                     except FitError:
                         pass  # capacity/relational race → host fallback
+            if explain_ctx is not None:
+                fe = explain_ctx.try_explain(task)
+                if fe is not None:
+                    # device-proven unschedulable: record the synthesized
+                    # FitErrors (the same writeback the host sweep feeds)
+                    # and skip the O(N) host predicate scan entirely
+                    job.nodes_fit_errors[task.uid] = fe
+                    return None
             return host_choose(task, job)
 
         drive_allocate_loop(
@@ -284,6 +435,47 @@ class JaxAllocateAction(Action):
             begin_job=lambda job: ssn.statement(),
             place_task=make_place_task(ssn, choose_node),
             end_job=gang_end_job(ssn),
+        )
+
+    def _publish_explain(
+        self, ssn: Session, ctx: Optional[_ExplainContext]
+    ) -> None:
+        """Per-cycle reason summary → trace journal + /explain surface.
+        A ``None`` context or an empty explained set CLEARS the surface
+        — it reflects the most recent cycle, never a stale one."""
+        from volcano_tpu.ops.explain import set_last_explain
+
+        if ctx is None or not ctx.explained:
+            set_last_explain(None)
+            return
+        summary = ctx.summary()
+        rec = ssn._trace
+        if rec.enabled:
+            rec.event(
+                "explain-summary", "action",
+                tasks=len(ctx.explained), reasons=summary,
+            )
+        from volcano_tpu import trace as _trace
+
+        detail = {}
+        if ctx.result.reasons is not None:
+            detail = {
+                uid: ctx.result.node_reasons(ctx.index[uid], ctx.node_names)
+                for uid in ctx.explained
+            }
+        set_last_explain(
+            {
+                "cycle": _trace.current_cycle(),
+                "n_nodes": ctx.result.n_nodes,
+                "tasks": {
+                    uid: {
+                        "reasons": hist,
+                        **({"nodes": detail[uid]} if uid in detail else {}),
+                    }
+                    for uid, hist in ctx.explained.items()
+                },
+                "summary": summary,
+            }
         )
 
 

@@ -89,6 +89,15 @@ class JaxPreemptAction(Action):
         evicted, pipelined = self._device_outcome(pk)
 
         if not evicted.any() and not (pipelined >= 0).any():
+            # nothing to evict — the preemptors stay Pending; explain
+            # the ones the device proves fit no node at all, so the
+            # Unschedulable event/condition writeback fires like on a
+            # host-scheduled cycle (ops/explain)
+            from volcano_tpu.ops.explain import (
+                synthesize_no_victim_explanations,
+            )
+
+            synthesize_no_victim_explanations(ssn, pk)
             metrics.register_preemption_attempts()
             return
 

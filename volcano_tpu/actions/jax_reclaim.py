@@ -50,6 +50,13 @@ class JaxReclaimAction(Action):
 
         evicted, pipelined = reclaim_dense(pk)
         if not evicted.any() and not (pipelined >= 0).any():
+            # no reclaimable victims — explain the provably-unplaceable
+            # reclaimers (same no-victim discipline as jax-preempt)
+            from volcano_tpu.ops.explain import (
+                synthesize_no_victim_explanations,
+            )
+
+            synthesize_no_victim_explanations(ssn, pk)
             return
 
         stmt = ssn.statement()

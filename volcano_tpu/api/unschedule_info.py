@@ -5,8 +5,9 @@ Reference: pkg/scheduler/api/unschedule_info.go.
 
 from __future__ import annotations
 
+import re
 from collections import Counter
-from typing import Dict, List
+from typing import Dict, List, Optional, Tuple
 
 # Well-known predicate failure reasons.
 NODE_RESOURCE_FIT_FAILED = "node(s) resource fit failed"
@@ -32,12 +33,48 @@ class FitError(Exception):
         )
 
 
+def format_fit_errors(total_nodes: int, histogram: Dict[str, int]) -> str:
+    """The reference's aggregate message (unschedule_info.go Error()):
+    ``0/N nodes are available: <count> <reason>, ...`` with the parts
+    lexicographically sorted.  The single copy of the format string —
+    host-collected FitErrors and device-derived reason counts both
+    render through it, which is what makes the two byte-comparable."""
+    parts = sorted(f"{count} {reason}" for reason, count in histogram.items())
+    return f"0/{total_nodes} nodes are available: {', '.join(parts)}."
+
+
+_FIT_ERROR_RE = re.compile(r"^0/(\d+) nodes are available: (.*)\.$")
+
+
+def parse_fit_errors(message: str) -> Optional[Tuple[int, Dict[str, int]]]:
+    """Inverse of :func:`format_fit_errors` → (total_nodes, histogram),
+    or None when the message is not an aggregate fit-error message
+    (e.g. a gang job_fit_errors summary).  Consumed by ``vtctl
+    describe``, which aggregates reason histograms back out of recorded
+    Unschedulable events."""
+    m = _FIT_ERROR_RE.match(message.strip())
+    if m is None:
+        return None
+    histogram: Dict[str, int] = {}
+    for part in m.group(2).split(", "):
+        count, _, reason = part.partition(" ")
+        if not count.isdigit() or not reason:
+            return None
+        histogram[reason] = histogram.get(reason, 0) + int(count)
+    return int(m.group(1)), histogram
+
+
 class FitErrors:
     """Aggregated per-node fit errors for one task (unschedule_info.go:22-110)."""
 
     def __init__(self):
         self.nodes: Dict[str, FitError] = {}
         self._message: str = ""
+        #: device-derived reason histogram (ops/explain synthesis) —
+        #: set instead of per-node FitError entries when the counts came
+        #: off the accelerator and per-node attribution was not retained
+        self._histogram: Optional[Dict[str, int]] = None
+        self._total_nodes: int = 0
 
     def set_node_error(self, node_name: str, err: FitError) -> None:
         self.nodes[node_name] = err
@@ -45,12 +82,26 @@ class FitErrors:
     def set_error(self, message: str) -> None:
         self._message = message
 
-    def error(self) -> str:
-        if self._message:
-            return self._message
+    def set_histogram(self, total_nodes: int, histogram: Dict[str, int]) -> None:
+        """Install an already-reduced reason histogram (the device
+        explain path) in place of per-node errors."""
+        self._histogram = dict(histogram)
+        self._total_nodes = total_nodes
+
+    def histogram(self) -> Dict[str, int]:
+        """reason → node count, whichever way this FitErrors was built."""
+        if self._histogram is not None:
+            return dict(self._histogram)
         histogram: Counter = Counter()
         for err in self.nodes.values():
             for reason in err.reasons:
                 histogram[reason] += 1
-        parts = sorted(f"{count} {reason}" for reason, count in histogram.items())
-        return f"0/{len(self.nodes)} nodes are available: {', '.join(parts)}."
+        return dict(histogram)
+
+    def error(self) -> str:
+        if self._message:
+            return self._message
+        total = (
+            self._total_nodes if self._histogram is not None else len(self.nodes)
+        )
+        return format_fit_errors(total, self.histogram())

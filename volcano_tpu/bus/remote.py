@@ -39,6 +39,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from volcano_tpu import trace
 from volcano_tpu.bus import protocol
 from volcano_tpu.bus.protocol import BusError, BusTimeoutError
 from volcano_tpu.client.apiserver import (
@@ -302,6 +303,14 @@ class RemoteAPIServer:
             raise BusError("bus client closed")
         timeout = timeout if timeout is not None else self.timeout
         method = payload.get("op", "ping")
+        if mtype == protocol.T_REQ:
+            # cross-process correlation: stamp the scheduling-cycle id on
+            # the request frame so server-side records (trace events, op
+            # logs) can be joined back to the cycle that caused them.
+            # Old servers ignore the key.
+            cycle = trace.current_cycle()
+            if cycle >= 0 and "cycle" not in payload:
+                payload["cycle"] = cycle
         start = time.perf_counter()
         if not self._connected.wait(timeout):
             metrics.observe_bus_request(method, time.perf_counter() - start,
@@ -391,6 +400,24 @@ class RemoteAPIServer:
         resp = self._call({"op": "delete", "kind": kind,
                            "namespace": namespace, "name": name})
         return protocol.decode_obj(resp["object"])
+
+    def record_event(
+        self,
+        namespace: str,
+        involved: dict,
+        type_: str,
+        reason: str,
+        message: str,
+    ):
+        """Event recorder over the bus — the same aggregate-by-
+        (object, type, reason) correlator the in-process clients use
+        (client.clients.record_event_via), so SchedulerCache audit
+        Events flow when the cache's client is a bare RemoteAPIServer
+        rather than a SchedulerClient wrapper."""
+        from volcano_tpu.client.clients import record_event_via
+
+        return record_event_via(self, namespace, involved, type_,
+                                reason, message)
 
     def register_admission(self, kind: str, operation: str, hook) -> None:
         """Make this client the webhook endpoint for (kind, operation).

@@ -39,6 +39,7 @@ import time
 import uuid
 from typing import Dict, List, Optional, Tuple
 
+from volcano_tpu import trace
 from volcano_tpu.bus import protocol
 from volcano_tpu.client.apiserver import AdmissionError, ApiError, APIServer
 from volcano_tpu.metrics import metrics
@@ -350,6 +351,16 @@ class BusServer:
     def _handle_request(self, conn: _Conn, req_id: int, payload: dict) -> None:
         op = payload.get("op", "")
         start = time.perf_counter()
+        rec = trace.get_recorder()
+        if rec.enabled and "cycle" in payload:
+            # cross-process correlation: the client stamped the request
+            # with its scheduling-cycle id (bus/remote.py) — record it so
+            # a pending task can be followed scheduler → bus →
+            # controllers by joining on the cycle id
+            rec.event(
+                "bus:" + op, "bus",
+                cycle=payload["cycle"], kind=payload.get("kind"),
+            )
         try:
             result = self._execute(conn, req_id, payload, op)
             if result is not None:

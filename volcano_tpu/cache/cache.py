@@ -181,6 +181,15 @@ class SchedulerCache(Cache):
         #: tasks whose async side effects failed; re-synced from API truth
         #: (cache.go:687-709 errTasks workqueue).
         self.err_tasks: List[TaskInfo] = []
+        #: one-shot flag for the "client can't record events" warning
+        self._warned_no_events = False
+        #: job uid → latest unschedulable writeback digest.  Fit errors
+        #: live on session clones (JobInfo.clone resets them), so the
+        #: status writeback below is the one durable point that sees
+        #: them — it parks a digest here for the /explain debug surface.
+        #: Cleared when the job's writeback carries no pending fit
+        #: errors anymore, and when the job leaves the cache.
+        self.unschedulable_digest: Dict[str, dict] = {}
 
         # ---- warm-cycle change tracking (ops/pack_cache.py) ----
         #: bumped on every pack-relevant mutation; the dirty dicts map
@@ -441,6 +450,7 @@ class SchedulerCache(Cache):
                 if not job.tasks:
                     del self.jobs[pg.key()]
                     self._job_mut_rev.pop(pg.key(), None)
+                    self.unschedulable_digest.pop(pg.key(), None)
 
     # ---- dual-version handlers (cache.go:393-424: the v1alpha1
     # informer set converts BOTH old and new through the scheme, then
@@ -739,6 +749,16 @@ class SchedulerCache(Cache):
         """Record a pod-scoped Event through the bus (the user-facing
         audit trail, cache.go:832-867, 600-610); best-effort."""
         if self.client is None or not hasattr(self.client, "record_event"):
+            # SchedulerClient and RemoteAPIServer both record; a client
+            # genuinely without the capability silently losing the audit
+            # trail is worth exactly one log line, not one per event
+            if self.client is not None and not self._warned_no_events:
+                self._warned_no_events = True
+                log.warning(
+                    "cache client %s cannot record events — the "
+                    "Scheduled/Unschedulable audit trail is disabled",
+                    type(self.client).__name__,
+                )
             return
         try:
             self.client.record_event(
@@ -872,16 +892,33 @@ class SchedulerCache(Cache):
         if self.status_updater is None:
             return
         base_message = job.job_fit_errors
+        tasks_digest: Dict[str, dict] = {}
         for task in job.tasks.values():
             if task.status != TaskStatus.Pending:
                 continue
             fit_errors = job.nodes_fit_errors.get(task.uid)
             message = fit_errors.error() if fit_errors is not None else base_message
+            if message:
+                tasks_digest[task.uid] = {
+                    "name": task.name,
+                    "message": message,
+                }
             self._record_event(task, "Warning", "Unschedulable", message)
             try:
                 self.status_updater.update_pod_condition(task, "Unschedulable", message)
             except Exception as e:  # noqa: BLE001
                 log.error("update pod condition failed: %s", e)
+        with self._mutex:
+            if tasks_digest:
+                self.unschedulable_digest[job.uid] = {
+                    "namespace": job.namespace,
+                    "name": job.name,
+                    "queue": job.queue,
+                    "job_fit_errors": job.job_fit_errors,
+                    "tasks": tasks_digest,
+                }
+            else:
+                self.unschedulable_digest.pop(job.uid, None)
 
     def update_job_status(self, job: JobInfo) -> Optional[scheduling.PodGroup]:
         """cache.go:871-894."""

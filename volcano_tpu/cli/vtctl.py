@@ -3,6 +3,8 @@
 Reference: cmd/cli/vcctl.go:43-49 + pkg/cli/{job,queue}:
   vtctl job run|list|view|suspend|resume|delete
   vtctl queue create|get|list|operate|delete
+  vtctl describe job|podgroup   (conditions + Events + the
+                                 unschedulable-reason histogram)
 
 Commands run against an APIServer instance: in-process when embedded
 (tests, single-process deployments) or a served endpoint when the control
@@ -155,20 +157,9 @@ def _job_view(vc: VolcanoClient, args, out) -> int:
     def _belongs(name: str) -> bool:
         return name == job.metadata.name or any(p.match(name) for p in patterns)
 
-    events = [
-        e
-        for e in vc.api.list("Event", args.namespace)
-        if _belongs(e.involved_object.get("name", ""))
-    ]
+    events = _collect_events(vc, args.namespace, _belongs)
     if events:
-        print("Events:", file=out)
-        print(f"  {'Type':<8} {'Count':<6} {'Reason':<18} {'Object':<32} Message", file=out)
-        for e in sorted(events, key=lambda e: e.metadata.resource_version):
-            obj = f"{e.involved_object.get('kind', '')}/{e.involved_object.get('name', '')}"
-            print(
-                f"  {e.type:<8} {e.count:<6} {e.reason:<18} {obj:<32} {e.message}",
-                file=out,
-            )
+        _print_events(events, out)
     return 0
 
 
@@ -267,6 +258,142 @@ def _queue_delete(vc: VolcanoClient, args, out) -> int:
     return 0
 
 
+# ---- describe subcommands (the "why is my job pending" surface) ----
+
+def _collect_events(vc: VolcanoClient, namespace: str, belongs) -> list:
+    return sorted(
+        (
+            e
+            for e in vc.api.list("Event", namespace)
+            if belongs(e.involved_object.get("name", ""))
+        ),
+        key=lambda e: e.metadata.resource_version,
+    )
+
+
+def _print_events(events, out) -> None:
+    if not events:
+        print("Events:             <none>", file=out)
+        return
+    print("Events:", file=out)
+    print(
+        f"  {'Type':<8} {'Count':<6} {'Reason':<18} {'Object':<32} Message",
+        file=out,
+    )
+    for e in events:
+        obj = f"{e.involved_object.get('kind', '')}/{e.involved_object.get('name', '')}"
+        print(
+            f"  {e.type:<8} {e.count:<6} {e.reason:<18} {obj:<32} {e.message}",
+            file=out,
+        )
+
+
+def _describe_scheduling(vc: VolcanoClient, namespace: str, name: str,
+                         pg, belongs, out) -> None:
+    """The shared body of ``describe job`` / ``describe podgroup``:
+    PodGroup conditions, the unschedulable-reason histogram aggregated
+    out of recorded Warning/Unschedulable Events, and the raw Events
+    table.  An aggregated Event's message is the LATEST occurrence's
+    detail (the correlator refreshes it), so each event contributes its
+    current per-reason node counts once — NOT multiplied by the
+    historical repeat count, which would inflate the current cause by
+    however long the task was stuck on a previous one.  Reads only the
+    API surface, so it renders identically over the in-process backend
+    and ``--bus``."""
+    from volcano_tpu.api.unschedule_info import parse_fit_errors
+
+    if pg is not None:
+        s = pg.status
+        print(f"Phase:              {s.phase}", file=out)
+        print(f"Min Member:         {pg.spec.min_member}", file=out)
+        print(f"Queue:              {pg.spec.queue}", file=out)
+        if s.conditions:
+            print("Conditions:", file=out)
+            print(f"  {'Type':<16} {'Status':<8} {'Reason':<22} Message", file=out)
+            for c in s.conditions:
+                print(
+                    f"  {c.type:<16} {c.status:<8} {c.reason:<22} {c.message}",
+                    file=out,
+                )
+        else:
+            print("Conditions:         <none>", file=out)
+    else:
+        print("PodGroup:           <none>", file=out)
+
+    events = _collect_events(vc, namespace, belongs)
+    histogram: Dict[str, int] = {}
+    for e in events:
+        if e.type != "Warning" or e.reason != "Unschedulable":
+            continue
+        parsed = parse_fit_errors(e.message)
+        if parsed is None:
+            continue
+        for reason, count in parsed[1].items():
+            histogram[reason] = histogram.get(reason, 0) + count
+    if histogram:
+        print("Unschedulable Reasons:", file=out)
+        print(f"  {'Nodes':<7} Reason", file=out)
+        for reason, count in sorted(histogram.items(), key=lambda kv: -kv[1]):
+            print(f"  {count:<7} {reason}", file=out)
+    _print_events(events, out)
+
+
+def _describe_job(vc: VolcanoClient, args, out) -> int:
+    job = vc.get_job(args.namespace, args.name)
+    if job is None:
+        print(f"job {args.namespace}/{args.name} not found", file=out)
+        return 1
+    print(f"Name:               {job.metadata.name}", file=out)
+    print(f"Namespace:          {job.metadata.namespace}", file=out)
+    print(f"Scheduler:          {job.spec.scheduler_name}", file=out)
+    s = job.status
+    print(
+        f"Status:             pending={s.pending} running={s.running} "
+        f"succeeded={s.succeeded} failed={s.failed}",
+        file=out,
+    )
+
+    # pod names follow <job>-<task>-<idx> (the _job_view matcher)
+    import re
+
+    jn = re.escape(job.metadata.name)
+    patterns = [
+        re.compile(rf"^{jn}-{re.escape(t.name)}-\d+$") for t in job.spec.tasks
+    ]
+
+    def belongs(name: str) -> bool:
+        return name == job.metadata.name or any(p.match(name) for p in patterns)
+
+    # the job controller names the PodGroup after the job (actions.go:423)
+    pg = vc.get_pod_group(args.namespace, args.name)
+    _describe_scheduling(vc, args.namespace, args.name, pg, belongs, out)
+    return 0
+
+
+def _describe_podgroup(vc: VolcanoClient, args, out) -> int:
+    pg = vc.get_pod_group(args.namespace, args.name)
+    if pg is None:
+        # the group may live on the bus as a raw v1alpha1 kind
+        from volcano_tpu.apis import scheme as _scheme
+
+        raw = vc.api.get("PodGroupV1alpha1", args.namespace, args.name)
+        if raw is not None:
+            pg = _scheme.pod_group_v1alpha1_to_hub(raw)
+    if pg is None:
+        print(f"podgroup {args.namespace}/{args.name} not found", file=out)
+        return 1
+    print(f"Name:               {pg.metadata.name}", file=out)
+    print(f"Namespace:          {pg.metadata.namespace}", file=out)
+
+    prefix = f"{pg.metadata.name}-"
+
+    def belongs(name: str) -> bool:
+        return name == pg.metadata.name or name.startswith(prefix)
+
+    _describe_scheduling(vc, args.namespace, args.name, pg, belongs, out)
+    return 0
+
+
 # ---- trace subcommands (volcano_tpu/trace) ----
 
 def _trace_record(vc: VolcanoClient, args, out) -> int:
@@ -338,7 +465,12 @@ def _trace_replay(vc: VolcanoClient, args, out) -> int:
 
 
 def _trace_diff(vc: VolcanoClient, args, out) -> int:
-    """Replay and print the per-task binding diff (empty when identical)."""
+    """Replay and print the per-task binding diff (empty when identical),
+    plus the cycle's recorded explain summary — a diff in which tasks
+    simply went unplaced reads very differently when the journal shows
+    the device proved them unschedulable (reason histogram) than when
+    scoring genuinely diverged."""
+    from volcano_tpu import trace as _trace
     from volcano_tpu.trace.replay import verify
 
     result = verify(args.dir, cycle=args.cycle, executor=args.executor)
@@ -351,6 +483,18 @@ def _trace_diff(vc: VolcanoClient, args, out) -> int:
         )
     if len(result.diffs) > args.limit:
         print(f"  ... {len(result.diffs) - args.limit} more", file=out)
+    try:
+        record = _trace.Journal(args.dir).read_cycle(result.cycle)
+    except Exception:  # noqa: BLE001 — events may be pruned; diff stands
+        record = {}
+    for e in record.get("events", []):
+        if e.get("name") in ("explain-summary", "explain-no-victim"):
+            a = e.get("args", {})
+            print(
+                f"  explain[{e['name']}]: {a.get('tasks', 0)} task(s) "
+                f"unschedulable, reasons: {a.get('reasons', {})}",
+                file=out,
+            )
     return 0 if result.match else 1
 
 
@@ -412,6 +556,15 @@ def build_parser() -> argparse.ArgumentParser:
     qd = queue.add_parser("delete")
     qd.add_argument("--name", "-N", required=True)
 
+    desc = sub.add_parser(
+        "describe",
+        description="conditions + events + unschedulable-reason histogram",
+    ).add_subparsers(dest="cmd", required=True)
+    for name in ("job", "podgroup"):
+        p = desc.add_parser(name)
+        p.add_argument("--name", "-N", required=True)
+        p.add_argument("--namespace", "-n", default="default")
+
     trace_p = sub.add_parser(
         "trace", description="cycle journal: record, replay, diff, export"
     ).add_subparsers(dest="cmd", required=True)
@@ -464,6 +617,8 @@ _HANDLERS = {
     ("queue", "list"): _queue_list,
     ("queue", "operate"): _queue_operate,
     ("queue", "delete"): _queue_delete,
+    ("describe", "job"): _describe_job,
+    ("describe", "podgroup"): _describe_podgroup,
     ("trace", "record"): _trace_record,
     ("trace", "replay"): _trace_replay,
     ("trace", "diff"): _trace_diff,

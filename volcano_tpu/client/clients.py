@@ -330,34 +330,51 @@ class SchedulerClient:
         reason: str,
         message: str,
     ) -> core.Event:
-        """Event recorder (the scheduler's user-facing audit trail —
-        cache.go:304-306 eventBroadcaster + :600-610, 832-867 call
-        sites).  Repeats of the same (object, type, reason) aggregate
-        into one Event with a bumped ``count`` — the k8s correlator's
-        aggregation key excludes the message precisely so that
-        variable-detail repeats (\"failed to bind to n1: ...\", \"... n2:
-        ...\") cannot mint unbounded distinct Events for one stuck
-        object across scheduling cycles."""
-        import hashlib
+        return record_event_via(self.api, namespace, involved, type_,
+                                reason, message)
 
-        digest = hashlib.sha1(
-            f"{involved.get('kind')}/{involved.get('name')}|{type_}|{reason}".encode()
-        ).hexdigest()[:10]
-        name = f"{involved.get('name', 'obj')}.{digest}"
-        existing = self.api.get("Event", namespace, name)
-        if existing is not None:
-            existing.count += 1
-            # refresh to the latest occurrence's detail, like the k8s
-            # correlator — operators act on the current cause, not the
-            # first-seen one
-            existing.message = message
-            return self.api.update(existing)
-        return self.kube.create_event(
-            core.Event(
-                metadata=core.ObjectMeta(name=name, namespace=namespace),
-                involved_object=involved,
-                type=type_,
-                reason=reason,
-                message=message,
-            )
+
+def record_event_via(
+    api,
+    namespace: str,
+    involved: dict,
+    type_: str,
+    reason: str,
+    message: str,
+) -> core.Event:
+    """Event recorder (the scheduler's user-facing audit trail —
+    cache.go:304-306 eventBroadcaster + :600-610, 832-867 call
+    sites).  Repeats of the same (object, type, reason) aggregate
+    into one Event with a bumped ``count`` — the k8s correlator's
+    aggregation key excludes the message precisely so that
+    variable-detail repeats (\"failed to bind to n1: ...\", \"... n2:
+    ...\") cannot mint unbounded distinct Events for one stuck
+    object across scheduling cycles.
+
+    ``api`` is any APIServer surface (in-process or a bus
+    RemoteAPIServer) — the single copy shared by SchedulerClient and
+    the bus client, so Events recorded over the wire aggregate
+    identically to in-process ones."""
+    import hashlib
+
+    digest = hashlib.sha1(
+        f"{involved.get('kind')}/{involved.get('name')}|{type_}|{reason}".encode()
+    ).hexdigest()[:10]
+    name = f"{involved.get('name', 'obj')}.{digest}"
+    existing = api.get("Event", namespace, name)
+    if existing is not None:
+        existing.count += 1
+        # refresh to the latest occurrence's detail, like the k8s
+        # correlator — operators act on the current cause, not the
+        # first-seen one
+        existing.message = message
+        return api.update(existing)
+    return api.create(
+        core.Event(
+            metadata=core.ObjectMeta(name=name, namespace=namespace),
+            involved_object=involved,
+            type=type_,
+            reason=reason,
+            message=message,
         )
+    )

@@ -40,13 +40,14 @@ class BaseDaemon:
         lease_duration: float = 2.0,
         retry_period: float = 0.2,
         debug_enabled: bool = False,
+        explain_source=None,
     ):
         self.api = api
         self.period = period
         self.identity = identity or f"{self.NAME}-{uuid.uuid4().hex[:8]}"
         self.serving = ServingServer(
             host=listen_host, port=listen_port, health_check=self.healthy,
-            debug_enabled=debug_enabled,
+            debug_enabled=debug_enabled, explain_source=explain_source,
         )
         self.elector: Optional[LeaderElector] = None
         if leader_elect:

@@ -16,6 +16,15 @@ from volcano_tpu.cmd.daemon import BaseDaemon, serve_forever
 from volcano_tpu.scheduler.scheduler import Scheduler
 
 
+def _explain_source(daemon: "SchedulerDaemon", namespace: str, job: str):
+    from volcano_tpu.serving.explain import explain_jobs
+
+    cache = getattr(daemon, "cache", None)
+    if cache is None:  # pragma: no cover — request before construction done
+        return {"jobs": []}
+    return explain_jobs(cache, namespace, job)
+
+
 class SchedulerDaemon(BaseDaemon):
     """The scheduler binary: cache + session loop + serving surface."""
 
@@ -32,7 +41,13 @@ class SchedulerDaemon(BaseDaemon):
         snapshot_reuse: bool = False,
         **daemon_kw,
     ):
-        super().__init__(api, period=schedule_period, **daemon_kw)
+        # /explain reads self.cache lazily (set right below) — the
+        # serving server only dereferences at request time
+        super().__init__(
+            api, period=schedule_period,
+            explain_source=lambda ns, job: _explain_source(self, ns, job),
+            **daemon_kw,
+        )
         self.cache = SchedulerCache(
             client=SchedulerClient(api),
             scheduler_name=scheduler_name,

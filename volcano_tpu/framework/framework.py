@@ -52,12 +52,26 @@ def open_session(
             ssn.plugins[plugin.name()] = plugin
 
     # Record incoming PodGroup status, filter invalid jobs at open
-    # (session.go:105-129).
+    # (session.go:105-129; the reference DeepCopies).  Must be a COPY:
+    # Session.job_status mutates job.pod_group.status in place, so a
+    # stored reference would alias the "new" status and the updater's
+    # is_pod_group_status_updated gate could never fire again once a
+    # job carried conditions — a stuck job that finally scheduled never
+    # got its phase written back.  Conditions entries are replaced (not
+    # mutated) by update_job_condition, so a shallow list copy is deep
+    # enough.
     for job in list(ssn.jobs.values()):
         if job.pod_group is not None:
-            ssn.pod_group_phase0[job.uid] = job.pod_group.status.phase
-            if job.pod_group.status.conditions:
-                ssn.pod_group_status[job.uid] = job.pod_group.status
+            st = job.pod_group.status
+            ssn.pod_group_phase0[job.uid] = st.phase
+            if st.conditions:
+                ssn.pod_group_status[job.uid] = scheduling.PodGroupStatus(
+                    phase=st.phase,
+                    conditions=list(st.conditions),
+                    running=st.running,
+                    succeeded=st.succeeded,
+                    failed=st.failed,
+                )
 
     for plugin in ssn.plugins.values():
         start = time.perf_counter()

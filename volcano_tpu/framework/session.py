@@ -80,6 +80,12 @@ class Session:
         #: hand untouched clones back for reuse
         self.touched_jobs: set = set()
         self.touched_nodes: set = set()
+        #: monotone count of node-state mutations (allocate / pipeline /
+        #: dispatch / evict / bulk apply).  Unlike len(touched_nodes),
+        #: it advances on REPEAT mutations of an already-touched node —
+        #: the explain synthesis gate compares epochs to know whether
+        #: node state moved since a pack (jax_allocate._ExplainContext).
+        self.node_state_epoch: int = 0
 
         self.tiers: List[Tier] = []
         self.configurations: List[Configuration] = []
@@ -402,6 +408,7 @@ class Session:
             raise KeyError(f"failed to find job {task.job} when pipelining")
         self.touched_jobs.add(task.job)
         self.touched_nodes.add(hostname)
+        self.node_state_epoch += 1
         job.update_task_status(task, TaskStatus.Pipelined)
         task.node_name = hostname
         node = self.nodes.get(hostname)
@@ -421,6 +428,7 @@ class Session:
             raise KeyError(f"failed to find job {task.job} when allocating")
         self.touched_jobs.add(task.job)
         self.touched_nodes.add(hostname)
+        self.node_state_epoch += 1
         job.update_task_status(task, TaskStatus.Allocated)
         task.node_name = hostname
         node = self.nodes.get(hostname)
@@ -460,6 +468,7 @@ class Session:
         self.cache.bind(task, task.node_name)
         self.touched_jobs.add(task.job)
         self.touched_nodes.add(task.node_name)
+        self.node_state_epoch += 1
         if self._trace.enabled:
             # one "bind" decision per actual cache.bind, same as the
             # Statement commit and fast-apply paths
@@ -474,6 +483,7 @@ class Session:
         self.cache.evict(reclaimee, reason)
         self.touched_jobs.add(reclaimee.job)
         self.touched_nodes.add(reclaimee.node_name)
+        self.node_state_epoch += 1
         if self._trace.enabled:
             self._trace.decision(
                 "evict", reclaimee.uid, reclaimee.node_name, reason

@@ -29,6 +29,7 @@ class Statement:
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         self.ssn.touched_jobs.add(reclaimee.job)
         self.ssn.touched_nodes.add(reclaimee.node_name)
+        self.ssn.node_state_epoch += 1
         job = self.ssn.jobs.get(reclaimee.job)
         if job is not None:
             job.update_task_status(reclaimee, TaskStatus.Releasing)
@@ -64,6 +65,7 @@ class Statement:
     def pipeline(self, task: TaskInfo, hostname: str) -> None:
         self.ssn.touched_jobs.add(task.job)
         self.ssn.touched_nodes.add(hostname)
+        self.ssn.node_state_epoch += 1
         job = self.ssn.jobs.get(task.job)
         if job is not None:
             job.update_task_status(task, TaskStatus.Pipelined)
@@ -88,6 +90,7 @@ class Statement:
     def allocate(self, task: TaskInfo, hostname: str) -> None:
         self.ssn.touched_jobs.add(task.job)
         self.ssn.touched_nodes.add(hostname)
+        self.ssn.node_state_epoch += 1
         self.ssn.cache.allocate_volumes(task, hostname)
         job = self.ssn.jobs.get(task.job)
         if job is None:

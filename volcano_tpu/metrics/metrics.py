@@ -179,6 +179,64 @@ def register_preemption_attempts() -> None:
     registry.inc(f"{_NAMESPACE}_total_preemption_attempts", {})
 
 
+def register_unschedulable_reason(reason: str, tasks: int = 1) -> None:
+    """volcano_unschedulable_task_reasons{reason}: tasks that stayed
+    pending this cycle with ``reason`` in their fit-error histogram —
+    the per-reason face of the Unschedulable event stream.  Recorded by
+    both the host predicate sweep and the device explain synthesis, so
+    the metric is path-independent.
+
+    Host fit-error reasons can interpolate object names ('pvc "ns/x"
+    not found') — an unbounded label value would mint one counter
+    series per stuck object, so anything outside the well-known reason
+    vocabulary lands under reason="other"."""
+    if reason not in _well_known_reasons():
+        reason = "other"
+    registry.inc(
+        f"{_NAMESPACE}_unschedulable_task_reasons", {"reason": reason}, tasks
+    )
+
+
+_WELL_KNOWN_REASONS: frozenset = frozenset()
+
+
+def _well_known_reasons() -> frozenset:
+    """Bounded label vocabulary for the per-reason counter (built
+    lazily — volcano_tpu.api must not import at metrics-module import
+    time)."""
+    global _WELL_KNOWN_REASONS
+    if not _WELL_KNOWN_REASONS:
+        from volcano_tpu.api import unschedule_info as ui
+
+        _WELL_KNOWN_REASONS = frozenset(
+            (
+                ui.NODE_RESOURCE_FIT_FAILED,
+                ui.NODE_POD_NUMBER_EXCEEDED,
+                ui.NODE_SELECTOR_MISMATCH,
+                ui.NODE_AFFINITY_MISMATCH,
+                ui.NODE_TAINT_UNTOLERATED,
+                ui.NODE_PORT_CONFLICT,
+                ui.NODE_UNSCHEDULABLE,
+                ui.NODE_NOT_READY,
+                ui.POD_AFFINITY_MISMATCH,
+                "node(s) had memory pressure",
+                "node(s) had disk pressure",
+                "node(s) had pid pressure",
+                "pod has unbound immediate PersistentVolumeClaims",
+            )
+        )
+    return _WELL_KNOWN_REASONS
+
+
+def update_explain_duration(seconds: float) -> None:
+    """volcano_explain_latency_milliseconds: cost of the on-device
+    reason-count reduction (ops/explain.run_explain) — the explain-mode
+    overhead bench/prof_explain_overhead.py budgets against action_ms."""
+    registry.histogram(
+        f"{_NAMESPACE}_explain_latency_milliseconds", {}
+    ).observe(seconds * 1e3)
+
+
 def update_unschedule_task_count(job_name: str, count: int) -> None:
     registry.set_gauge(f"{_NAMESPACE}_unschedule_task_count", {"job": job_name}, count)
 

@@ -71,6 +71,44 @@ def _get_remote() -> Optional[_Remote]:
 #: did the last execute_allocate run in-process or on the sidecar?
 _last_route = "local"
 
+#: reason counts of the last execute_allocate(explain=True) — [T, P]
+#: int32 aligned with the snapshot's ordered tasks, or None when the
+#: session needed no explanation (everything placed) or explain was
+#: off.  Same single-threaded read-right-after-the-call discipline as
+#: the dispatch state above.
+_last_explain_counts = None
+
+#: wall-clock ms of the reduction behind _last_explain_counts, or None
+#: when the counts were reduced REMOTELY (the sidecar's own metrics
+#: carry that cost — reporting a stale local number here would
+#: fabricate phase stats in remote-executor configurations)
+_last_explain_ms = None
+
+
+def last_explain_counts():
+    return _last_explain_counts
+
+
+def last_explain_ms():
+    return _last_explain_ms
+
+
+def _maybe_explain(snap, assignment) -> None:
+    """Lazy explain: the reason-count reduction runs only when a valid
+    task went unplaced — fully-placed warm cycles pay nothing — and
+    only over the unplaced rows."""
+    global _last_explain_counts, _last_explain_ms
+    _last_explain_counts = None
+    _last_explain_ms = None
+    unplaced = np.nonzero(np.asarray(assignment)[: snap.n_tasks] < 0)[0]
+    if unplaced.size:
+        from volcano_tpu.ops import explain as _explain
+
+        _last_explain_counts = _explain.run_explain(
+            snap, task_rows=unplaced
+        ).counts
+        _last_explain_ms = _explain.last_run_ms
+
 
 def last_allocate_executor() -> str:
     """Name of what the most recent execute_allocate actually ran —
@@ -89,8 +127,17 @@ def last_allocate_executor() -> str:
     return _dispatch_last()
 
 
-def execute_allocate(snap, weights=None, gang_rounds: int = 3) -> np.ndarray:
-    """PackedSnapshot → assignment, via sidecar when configured."""
+def execute_allocate(
+    snap, weights=None, gang_rounds: int = 3, explain: bool = False
+) -> np.ndarray:
+    """PackedSnapshot → assignment, via sidecar when configured.
+
+    ``explain=True`` additionally computes the per-task reason-count
+    matrix when any valid task went unplaced (read it back with
+    :func:`last_explain_counts`).  The sidecar computes the counts
+    against the snapshot it already holds — same request, no second
+    round trip; a pre-explain sidecar returns no counts and the local
+    reduction fills in."""
     from volcano_tpu.ops.dispatch import run_packed_auto
     from volcano_tpu.ops.kernels import DEFAULT_WEIGHTS
 
@@ -102,7 +149,7 @@ def execute_allocate(snap, weights=None, gang_rounds: int = 3) -> np.ndarray:
     # the wire protocol carries neither weights nor gang_rounds — only
     # default-configured sessions may route remotely, or the sidecar
     # would silently run different parameters than the fallback
-    global _last_route
+    global _last_route, _last_explain_counts, _last_explain_ms
     if (
         remote is not None
         and weights == DEFAULT_WEIGHTS
@@ -111,8 +158,18 @@ def execute_allocate(snap, weights=None, gang_rounds: int = 3) -> np.ndarray:
     ):
         try:
             with rec.span("executor:remote-allocate", "kernel"):
-                out = remote.client.allocate(snap)
+                out = remote.client.allocate(snap, explain=explain)
             _last_route = "remote"
+            _last_explain_counts = None
+            _last_explain_ms = None
+            if explain:
+                counts = remote.client.last_reason_counts
+                if counts is not None:
+                    _last_explain_counts = counts
+                else:
+                    # pre-explain sidecar — same lazy unplaced-rows
+                    # reduction as the local path
+                    _maybe_explain(snap, out)
             return out
         except Exception as e:  # noqa: BLE001 — degrade to in-process
             remote.healthy = False
@@ -122,7 +179,13 @@ def execute_allocate(snap, weights=None, gang_rounds: int = 3) -> np.ndarray:
                 "compute plane allocate failed (%s); in-process fallback", e
             )
     _last_route = "local"
-    return run_packed_auto(snap, weights=weights, gang_rounds=gang_rounds)
+    out = run_packed_auto(snap, weights=weights, gang_rounds=gang_rounds)
+    if explain:
+        _maybe_explain(snap, out)
+    else:
+        _last_explain_counts = None
+        _last_explain_ms = None
+    return out
 
 
 def execute_preempt(pk) -> Tuple[np.ndarray, np.ndarray]:

@@ -69,21 +69,21 @@ def f32_lr_exact(snap: "PackedSnapshot") -> bool:
 
 # ---- predicate mask (vectorized over all T×N pairs) ----
 
-def predicate_mask(
+def _component_planes(
     task_resreq: jnp.ndarray,  # [T, R]
     task_sel_bits: jnp.ndarray,  # [T, W] uint32
     task_tol_bits: jnp.ndarray,  # [T, W] uint32
     node_future_idle: jnp.ndarray,  # [N, R]
     node_label_bits: jnp.ndarray,  # [N, W]
     node_taint_bits: jnp.ndarray,  # [N, W]
-    node_ok: jnp.ndarray,  # [N] bool
     node_task_count: jnp.ndarray,  # [N] i32
     node_max_tasks: jnp.ndarray,  # [N] i32
     tolerance: jnp.ndarray,  # [R]
-) -> jnp.ndarray:
-    """[T, N] feasibility — resource fit (LessEqual w/ tolerance,
-    resource_info.go:292-326), selector/affinity bits, taint bits, pod
-    count, node readiness."""
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The four task-dependent predicate planes (fit, sel_ok, tol_ok,
+    room), each [T, N] bool — the single copy shared by the AND-ing hot
+    mask (predicate_mask) and the explain reduction (explain_counts),
+    so the explanation can never disagree with the decision."""
     # resreq <= future_idle with per-lane tolerance margin.  The
     # sub-tolerance skip applies to scalar lanes only — host LessEqual
     # (resource_info.go:292-326) short-circuits small *scalars* but still
@@ -103,7 +103,97 @@ def predicate_mask(
         (node_taint_bits[None, :, :] & ~task_tol_bits[:, None, :]) == 0, axis=-1
     )
     room = (node_task_count < node_max_tasks)[None, :]
+    return fit, sel_ok, tol_ok, room
+
+
+def predicate_mask(
+    task_resreq: jnp.ndarray,  # [T, R]
+    task_sel_bits: jnp.ndarray,  # [T, W] uint32
+    task_tol_bits: jnp.ndarray,  # [T, W] uint32
+    node_future_idle: jnp.ndarray,  # [N, R]
+    node_label_bits: jnp.ndarray,  # [N, W]
+    node_taint_bits: jnp.ndarray,  # [N, W]
+    node_ok: jnp.ndarray,  # [N] bool
+    node_task_count: jnp.ndarray,  # [N] i32
+    node_max_tasks: jnp.ndarray,  # [N] i32
+    tolerance: jnp.ndarray,  # [R]
+) -> jnp.ndarray:
+    """[T, N] feasibility — resource fit (LessEqual w/ tolerance,
+    resource_info.go:292-326), selector/affinity bits, taint bits, pod
+    count, node readiness."""
+    fit, sel_ok, tol_ok, room = _component_planes(
+        task_resreq, task_sel_bits, task_tol_bits, node_future_idle,
+        node_label_bits, node_taint_bits, node_task_count, node_max_tasks,
+        tolerance,
+    )
     return fit & sel_ok & tol_ok & room & node_ok[None, :]
+
+
+# ---- explain: first-failure reason planes + on-device histogram ----
+
+#: reason-plane order = the HOST first-failure precedence: the resource
+#: fit check prepended by actions/allocate.make_predicate_fn, then the
+#: predicates plugin's own order (pod count, unschedulable, selector,
+#: taints — plugins/predicates.py:48-95).  Within a session every node
+#: passed ready() at snapshot time (cache.snapshot skips unready nodes),
+#: so the packed ¬node_ok is exactly "unschedulable".
+N_EXPLAIN_REASONS = 5
+R_FIT, R_ROOM, R_UNSCHED, R_SEL, R_TOL = range(N_EXPLAIN_REASONS)
+
+
+@jax.jit
+def explain_counts(
+    task_resreq: jnp.ndarray,  # [T, R]
+    task_sel_bits: jnp.ndarray,  # [T, W] uint32
+    task_tol_bits: jnp.ndarray,  # [T, W] uint32
+    node_future_idle: jnp.ndarray,  # [N, R]
+    node_label_bits: jnp.ndarray,  # [N, W]
+    node_taint_bits: jnp.ndarray,  # [N, W]
+    node_ok: jnp.ndarray,  # [N] bool
+    node_task_count: jnp.ndarray,  # [N] i32
+    node_max_tasks: jnp.ndarray,  # [N] i32
+    tolerance: jnp.ndarray,  # [R]
+    n_nodes: jnp.ndarray,  # i32 scalar — valid node rows (rest padding)
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(reason[T, N] i8, counts[T, P] i32).
+
+    ``reason[t, n]`` is the index of the FIRST predicate the pair fails
+    in host order, or ``N_EXPLAIN_REASONS`` when the node is feasible
+    (or padding).  ``counts[t, p]`` is the number of valid nodes whose
+    first failure for task ``t`` is reason ``p`` — the on-device
+    reduction of the reference's FitErrors histogram
+    (unschedule_info.go), so a 50k×10k explanation costs a handful of
+    [T, N] reductions instead of a host predicate sweep."""
+    fit, sel_ok, tol_ok, room = _component_planes(
+        task_resreq, task_sel_bits, task_tol_bits, node_future_idle,
+        node_label_bits, node_taint_bits, node_task_count, node_max_tasks,
+        tolerance,
+    )
+    ok = node_ok[None, :]
+    feasible = jnp.int8(N_EXPLAIN_REASONS)
+    reason = jnp.where(
+        ~fit, jnp.int8(R_FIT),
+        jnp.where(
+            ~room, jnp.int8(R_ROOM),
+            jnp.where(
+                ~ok, jnp.int8(R_UNSCHED),
+                jnp.where(
+                    ~sel_ok, jnp.int8(R_SEL),
+                    jnp.where(~tol_ok, jnp.int8(R_TOL), feasible),
+                ),
+            ),
+        ),
+    )
+    valid = jnp.arange(reason.shape[1]) < n_nodes
+    reason = jnp.where(valid[None, :], reason, feasible)
+    counts = jnp.stack(
+        [
+            jnp.sum(reason == jnp.int8(p), axis=1, dtype=jnp.int32)
+            for p in range(N_EXPLAIN_REASONS)
+        ],
+        axis=1,
+    )
+    return reason, counts
 
 
 # ---- scores (closed-form plugin math) ----

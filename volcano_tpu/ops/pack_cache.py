@@ -561,10 +561,11 @@ class PackCache:
         # --- flags + bookkeeping ---
         snap.task_uids = curr_uids
         snap.node_names = node_names
+        snap.registry_overflow = bool(
+            self.label_reg.overflow or self.taint_reg.overflow
+        )
         snap.needs_host_validation = bool(
-            snap.task_needs_host[:T].any()
-            or self.label_reg.overflow
-            or self.taint_reg.overflow
+            snap.task_needs_host[:T].any() or snap.registry_overflow
         )
         snap.memory_exact = bool(
             self._task_mem_ok[:T].all()

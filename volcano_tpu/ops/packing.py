@@ -122,6 +122,11 @@ class PackedSnapshot:
     #: False when a memory quantity was not MiB-aligned (lane rounds).
     memory_exact: bool = True
 
+    #: True when the label/taint bit registry overflowed — the sel/tol
+    #: planes of EVERY row are then suspect, not just flagged tasks.
+    #: Host bookkeeping (the explain synthesis gate); not serialized.
+    registry_overflow: bool = False
+
     #: [T] bool — tasks carrying preferred (anti-)affinity terms the kernel
     #: cannot score; jax-allocate routes these to the host path.
     task_has_preferences: np.ndarray = None
@@ -581,5 +586,6 @@ def pack_session(
 
     if label_reg.overflow or taint_reg.overflow:
         snap.needs_host_validation = True
+        snap.registry_overflow = True
 
     return snap

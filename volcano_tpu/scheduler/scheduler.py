@@ -52,6 +52,9 @@ class Scheduler:
         self.gc_quiesce_period = gc_quiesce_period
         self._cycles_since_quiesce = 0
         self._stopped = False
+        #: monotonically increasing cycle sequence — the cross-process
+        #: correlation id when no trace recorder assigns one
+        self._cycle_seq = -1
 
     def _load_conf(self) -> SchedulerConf:
         """Hot-reload every cycle (scheduler.go:77,89-106)."""
@@ -76,7 +79,13 @@ class Scheduler:
     def run_once(self) -> None:
         """scheduler.go:71-87."""
         rec = trace.get_recorder()
-        rec.begin_cycle()
+        cid = rec.begin_cycle()
+        # cycle correlation id: the recorder's cycle id when tracing,
+        # else a local sequence — attached to VBUS request frames
+        # (bus/remote.py) so bus/controller-side records can be joined
+        # back to the scheduling cycle that caused them
+        self._cycle_seq += 1
+        trace.set_current_cycle(cid if cid >= 0 else self._cycle_seq)
         start = time.perf_counter()
         ssn = None
         try:

@@ -97,7 +97,7 @@ def _unpack_arrays(payload: bytes) -> Tuple[Dict, Dict[str, np.ndarray]]:
     return head["meta"], arrays
 
 
-def serialize_snapshot(snap) -> bytes:
+def serialize_snapshot(snap, explain: bool = False) -> bytes:
     meta = {k: getattr(snap, k) for k in _SNAP_META}
     meta["resource_names"] = list(snap.resource_names)
     # warm-session identity: lets the server retain the snapshot so the
@@ -105,6 +105,10 @@ def serialize_snapshot(snap) -> bytes:
     if getattr(snap, "cache_key", None):
         meta["cache_key"] = snap.cache_key
         meta["rev"] = snap.rev
+    if explain:
+        # ask the server to return reason counts for unplaced tasks
+        # alongside the assignment (ignored by pre-explain servers)
+        meta["explain"] = True
     arrays = {k: getattr(snap, k) for k in _SNAP_ARRAYS}
     return _pack_arrays(meta, arrays)
 
@@ -126,7 +130,7 @@ def _snapshot_from(meta: Dict, arrays: Dict[str, np.ndarray]):
     return snap
 
 
-def serialize_delta(snap) -> bytes:
+def serialize_delta(snap, explain: bool = False) -> bytes:
     """Delta frame payload: scalar meta + per-plane changes.  A plane is
     shipped as ``full__<name>`` (replace), or as ``idx__<name>`` +
     ``row__<name>`` (scatter into the server-held copy); planes absent
@@ -137,6 +141,8 @@ def serialize_delta(snap) -> bytes:
     meta["cache_key"] = snap.cache_key
     meta["rev"] = snap.rev
     meta["base_rev"] = delta.base_rev
+    if explain:
+        meta["explain"] = True
     arrays: Dict[str, np.ndarray] = {}
     for name in _SNAP_ARRAYS:
         if name not in delta.planes:
@@ -272,6 +278,26 @@ class _SessionStore:
 _session_store = _SessionStore()
 
 
+def _alloc_response(snap, meta: Dict, assignment: np.ndarray) -> bytes:
+    """T_ALLOC_RESP payload.  When the request asked for an explanation
+    (``meta["explain"]``) and a valid task went unplaced, the per-task
+    reason-count matrix rides back alongside the assignment — the
+    server holds the snapshot already, so the explanation costs no
+    extra round trip or re-serialization.  Pre-explain clients never
+    set the flag; pre-explain servers ignore it (the client then
+    reduces locally)."""
+    arrays = {"assignment": assignment}
+    if meta.get("explain"):
+        unplaced = np.nonzero(assignment[: snap.n_tasks] < 0)[0]
+        if unplaced.size:
+            from volcano_tpu.ops.explain import run_explain
+
+            arrays["reason_counts"] = run_explain(
+                snap, task_rows=unplaced
+            ).counts
+    return _pack_arrays({}, arrays)
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):  # one connection, many requests
         while True:
@@ -296,7 +322,7 @@ class _Handler(socketserver.BaseRequestHandler):
                         )
                     _send_frame(
                         self.request, T_ALLOC_RESP,
-                        _pack_arrays({}, {"assignment": assignment}),
+                        _alloc_response(snap, meta, assignment),
                     )
                 elif mtype == T_ALLOC_DELTA_REQ:
                     from volcano_tpu.ops.dispatch import run_packed_auto
@@ -313,7 +339,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     )
                     _send_frame(
                         self.request, T_ALLOC_RESP,
-                        _pack_arrays({}, {"assignment": assignment}),
+                        _alloc_response(snap, meta, assignment),
                     )
                 elif mtype == T_PREEMPT_REQ:
                     from volcano_tpu.ops.dispatch import run_preempt_auto
@@ -389,6 +415,9 @@ class ComputePlaneClient:
         #: set after an "unknown type" error — an old sidecar; stop
         #: attempting delta frames until reconnect
         self._delta_unsupported = False
+        #: reason counts from the last allocate(explain=True) response —
+        #: None when everything placed or the server predates explain
+        self.last_reason_counts: Optional[np.ndarray] = None
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
@@ -415,8 +444,9 @@ class ComputePlaneClient:
         except Exception:  # noqa: BLE001
             return False
 
-    def allocate(self, snap) -> np.ndarray:
+    def allocate(self, snap, explain: bool = False) -> np.ndarray:
         key = getattr(snap, "cache_key", None)
+        self.last_reason_counts = None
         if (
             key
             and snap.delta is not None
@@ -424,11 +454,12 @@ class ComputePlaneClient:
             and self._acked.get(key) == snap.delta.base_rev
         ):
             mtype, payload = self._roundtrip(
-                T_ALLOC_DELTA_REQ, serialize_delta(snap)
+                T_ALLOC_DELTA_REQ, serialize_delta(snap, explain=explain)
             )
             if mtype == T_ALLOC_RESP:
                 self._acked[key] = snap.rev
                 _, arrays = _unpack_arrays(payload)
+                self.last_reason_counts = arrays.get("reason_counts")
                 return arrays["assignment"]
             if mtype == T_ERROR:
                 msg = payload.decode()
@@ -438,12 +469,15 @@ class ComputePlaneClient:
                 self._delta_unsupported = True
                 log.info("compute plane %s has no delta support", self.socket_path)
             # T_NEED_FULL (or unsupported) → full frame below re-seeds
-        mtype, payload = self._roundtrip(T_ALLOC_REQ, serialize_snapshot(snap))
+        mtype, payload = self._roundtrip(
+            T_ALLOC_REQ, serialize_snapshot(snap, explain=explain)
+        )
         if mtype == T_ERROR:
             raise RuntimeError(f"compute plane: {payload.decode()}")
         if key:
             self._acked[key] = snap.rev
         _, arrays = _unpack_arrays(payload)
+        self.last_reason_counts = arrays.get("reason_counts")
         return arrays["assignment"]
 
     def preempt(self, pk) -> Tuple[np.ndarray, np.ndarray]:

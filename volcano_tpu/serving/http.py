@@ -13,6 +13,11 @@ admission do the same.  Here one small threaded server carries both:
                      chrome://tracing / Perfetto.  Forensics, so gated
                      like /debug/stacks: loopback always, non-loopback
                      only with debug_enabled
+  GET /explain     → JSON "why is my job pending": unschedulable jobs,
+                     their per-task fit-error messages and reason
+                     histograms (serving/explain.py).  Narrow with
+                     ?namespace=&job=.  Scheduler daemon only; gated
+                     like /debug/stacks
 
 No third-party client library — metrics._Registry.render() already
 emits the text format.
@@ -83,6 +88,38 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             body = json.dumps(chrome_trace(record)).encode()
             ctype = "application/json"
+        elif self.path == "/explain" or self.path.startswith("/explain?"):
+            # unschedulability forensics (job/task names, node names,
+            # failure reasons) — same sensitivity class and gate as
+            # /debug/stacks
+            if self._deny_unless_debug():
+                return
+            source = getattr(self.server, "explain_source", None)
+            if source is None:
+                body = b"no explain source (scheduler daemon only)"
+                self.send_response(404)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            import json
+            from urllib.parse import parse_qs, urlsplit
+
+            query = parse_qs(urlsplit(self.path).query)
+            data = source(
+                query.get("namespace", [""])[0], query.get("job", [""])[0]
+            )
+            if data is None:
+                body = b"job not found or nothing recorded"
+                self.send_response(404)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            body = json.dumps(data).encode()
+            ctype = "application/json"
         elif self.path == "/debug/stacks":
             # the pprof-goroutine analogue (cmd/scheduler/main.go:25
             # imports net/http/pprof): live thread stacks for hang
@@ -137,6 +174,7 @@ class ServingServer:
         health_check=None,
         debug_enabled: bool = False,
         recorder=None,
+        explain_source=None,
     ):
         self._host = host
         self._port = port
@@ -149,6 +187,9 @@ class ServingServer:
         #: trace recorder serving /trace/last; None = the process-global
         #: recorder at request time (trace.get_recorder())
         self._recorder = recorder
+        #: optional (namespace, job) -> dict|None backing /explain —
+        #: the scheduler daemon wires serving/explain.explain_jobs here
+        self._explain_source = explain_source
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -163,6 +204,7 @@ class ServingServer:
         self._httpd.health_check = self._health_check
         self._httpd.debug_enabled = self._debug_enabled
         self._httpd.recorder = self._recorder
+        self._httpd.explain_source = self._explain_source
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="vtpu-serving", daemon=True
         )

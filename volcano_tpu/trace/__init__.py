@@ -38,6 +38,23 @@ from volcano_tpu.trace.replay import ReplayResult, run_snapshot, verify
 _NULL = NullRecorder()
 _recorder = _NULL
 
+#: correlation id of the scheduling cycle currently executing in this
+#: process (-1 outside a cycle).  Set by the scheduler loop every
+#: run_once — independent of whether a recorder is installed — and
+#: attached to outbound VBUS request frames (bus/remote.py) so a
+#: pending task can be followed scheduler → bus → controllers across
+#: process boundaries.
+_current_cycle: int = -1
+
+
+def set_current_cycle(cycle_id: int) -> None:
+    global _current_cycle
+    _current_cycle = cycle_id
+
+
+def current_cycle() -> int:
+    return _current_cycle
+
 
 def get_recorder():
     """The active recorder — NullRecorder unless :func:`enable` (or
@@ -75,6 +92,8 @@ __all__ = [
     "ReplayResult",
     "TraceRecorder",
     "chrome_trace",
+    "current_cycle",
+    "set_current_cycle",
     "disable",
     "enable",
     "export_chrome_trace",
