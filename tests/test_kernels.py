@@ -242,3 +242,86 @@ def test_kernel_respects_existing_usage():
     host = _host_bindings(mk())
     dev = _device_bindings(mk())
     assert host == dev == {"ns/new1": "n2"}
+
+
+# ---- gang-fixpoint cascade depth (VERDICT weak #6) ----
+
+
+def _cascade_snapshot(n_nodes: int = 2):
+    """A session whose gang cascade does NOT settle in one round:
+
+    scan order [b0, b1, a0]; job B = {b0, b1} with min_available=2 but
+    b1 unplaceable, job A = {a0} with min_available=1; two identical
+    one-task nodes (tie-break → n0 first).  Round 1 places b0@n0 and
+    a0@n1, then discards job B (1 < 2 ready) — so round 2 would move a0
+    onto the freed n0.  With gang_rounds=1 the bounded loop ships the
+    round-1 commit (a0@n1), the documented deviation; the reference
+    discards until stable (a0@n0)."""
+    from volcano_tpu.ops.packing import PackedSnapshot
+
+    snap = PackedSnapshot()
+    snap.resource_names = ["cpu", "memory"]
+    snap.tolerance = np.array([10.0, 10.0], dtype=np.float32)
+    snap.n_tasks, snap.n_nodes, snap.n_jobs = 3, n_nodes, 2
+    snap.task_resreq = np.array(
+        [[1000.0, 2048.0], [50000.0, 99999.0], [1000.0, 2048.0]],
+        dtype=np.float32,
+    )
+    snap.task_job = np.array([1, 1, 0], dtype=np.int32)
+    snap.task_sel_bits = np.zeros((3, 2), dtype=np.uint32)
+    snap.task_tol_bits = np.zeros((3, 2), dtype=np.uint32)
+    snap.node_idle = np.tile(
+        np.array([[1000.0, 2048.0]], dtype=np.float32), (n_nodes, 1)
+    )
+    snap.node_used = np.zeros((n_nodes, 2), dtype=np.float32)
+    snap.node_alloc = snap.node_idle.copy()
+    snap.node_label_bits = np.zeros((n_nodes, 2), dtype=np.uint32)
+    snap.node_taint_bits = np.zeros((n_nodes, 2), dtype=np.uint32)
+    snap.node_ok = np.ones(n_nodes, dtype=bool)
+    snap.node_task_count = np.zeros(n_nodes, dtype=np.int32)
+    snap.node_max_tasks = np.full(n_nodes, 110, dtype=np.int32)
+    snap.job_min_available = np.array([1, 2], dtype=np.int32)
+    snap.job_ready_count = np.zeros(2, dtype=np.int32)
+    snap.task_has_preferences = np.zeros(3, dtype=bool)
+    return snap
+
+
+class TestGangCascadeDepth:
+    def test_bounded_rounds_ship_last_commit(self):
+        # the documented deviation: one round is not enough for the
+        # cascade, and the bounded loop ships round 1's (valid) commit
+        out = run_packed(_cascade_snapshot(), gang_rounds=1)
+        np.testing.assert_array_equal(out, [-1, -1, 1])
+
+    def test_enough_rounds_reach_the_fixpoint(self):
+        out = run_packed(_cascade_snapshot(), gang_rounds=3)
+        np.testing.assert_array_equal(out, [-1, -1, 0])
+
+    def test_discard_until_stable_matches_reference_semantics(self):
+        # statement.go:309-337: even with the round budget exhausted,
+        # discard mode keeps discarding until the active set is stable
+        out = run_packed(
+            _cascade_snapshot(), gang_rounds=1, discard_unstable=True
+        )
+        np.testing.assert_array_equal(out, [-1, -1, 0])
+
+    def test_blocked_formulation_same_cascade_semantics(self):
+        from volcano_tpu.ops.blocked import run_packed_blocked
+
+        # the blocked kernel's top-K tracking needs >= K nodes
+        bounded = run_packed_blocked(_cascade_snapshot(n_nodes=9), gang_rounds=1)
+        np.testing.assert_array_equal(bounded, [-1, -1, 1])
+        stable = run_packed_blocked(
+            _cascade_snapshot(n_nodes=9), gang_rounds=1, discard_unstable=True
+        )
+        np.testing.assert_array_equal(stable, [-1, -1, 0])
+
+    def test_env_opt_in_routes_dispatch(self, monkeypatch):
+        from volcano_tpu.ops import dispatch
+
+        monkeypatch.setenv("VTPU_GANG_DISCARD_UNSTABLE", "1")
+        assert dispatch.gang_discard_unstable()
+        out = dispatch.run_packed_auto(_cascade_snapshot(), gang_rounds=1)
+        np.testing.assert_array_equal(out, [-1, -1, 0])
+        monkeypatch.setenv("VTPU_GANG_DISCARD_UNSTABLE", "0")
+        assert not dispatch.gang_discard_unstable()

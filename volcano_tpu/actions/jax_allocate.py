@@ -279,10 +279,26 @@ class JaxAllocateAction(Action):
         # makes the executor return the reason-count matrix alongside
         # the assignment when tasks went unplaced (lazy — a fully-placed
         # session computes nothing extra).
-        assignment = execute_allocate(
-            snap, weights=self.weights, gang_rounds=self.gang_rounds,
-            explain=self.explain,
-        )
+        from volcano_tpu.faults.watchdog import CycleDeadlineExceeded
+
+        try:
+            assignment = execute_allocate(
+                snap, weights=self.weights, gang_rounds=self.gang_rounds,
+                explain=self.explain,
+            )
+        except CycleDeadlineExceeded as e:
+            # cycle watchdog: the device phase overran its budget and
+            # was abandoned.  Nothing session-side has mutated (the
+            # device phase is pure), so the cycle completes on the host
+            # scoring path: no proposals → every task takes host_choose
+            # in _apply.  The demotion is journaled and counted.
+            log.error("device phase abandoned: %s", e)
+            metrics.register_executor_fallback("device", "host", "deadline")
+            rec = ssn._trace
+            if rec.enabled:
+                rec.event("watchdog:device-phase-abandoned", "fault",
+                          error=str(e))
+            return {}, snap
         metrics.update_kernel_duration("execute", time.perf_counter() - t0)
 
         rec = ssn._trace

@@ -316,6 +316,16 @@ class RemoteAPIServer:
             metrics.observe_bus_request(method, time.perf_counter() - start,
                                         "disconnected")
             raise BusError(f"bus {self.address} unreachable")
+        from volcano_tpu import faults
+
+        fp = faults.get_plane()
+        if fp.enabled and mtype == protocol.T_REQ and fp.should("bus.client_drop"):
+            # the request frame never reaches the wire: callers see the
+            # same BusError a mid-send connection loss produces, and the
+            # daemon work loops retry next cycle
+            metrics.observe_bus_request(method, time.perf_counter() - start,
+                                        "disconnected")
+            raise BusError("fault-injected: request frame lost")
         req_id = self._next_id()
         waiter = {"event": threading.Event(), "result": None,
                   "error": None, "error_payload": None, "on_reply": on_reply}

@@ -107,11 +107,19 @@ class _Conn:
         self.reviews.clear()
 
     def write_loop(self) -> None:
+        from volcano_tpu import faults
+
         while True:
             item = self.outbound.get()
             if item is None or self.closed:
                 return
             mtype, corr_id, payload = item
+            fp = faults.get_plane()
+            if fp.enabled and fp.should("bus.delay"):
+                # latency injection lives on the writer thread, NOT the
+                # store-side notifier — a slow wire must never stall the
+                # store (the decoupling this queue exists for)
+                time.sleep(fp.param_ms("bus.delay") / 1e3)
             try:
                 protocol.send_frame(self.sock, mtype, corr_id, payload)
             except (OSError, ValueError):
@@ -228,6 +236,8 @@ class BusServer:
     # ---- event backlog + fan-out (runs under the store lock) ----
 
     def _make_central_watcher(self, kind: str):
+        from volcano_tpu import faults
+
         def on_event(event, old, new):
             self._seq += 1
             entry = {
@@ -241,7 +251,18 @@ class BusServer:
             self._backlog.append(entry)
             if len(self._backlog) > self.backlog_size:
                 del self._backlog[: len(self._backlog) - self.backlog_size]
-            for conn, watch_id in self._subs.get(kind, []):
+            fp = faults.get_plane()
+            for conn, watch_id in list(self._subs.get(kind, [])):
+                if fp.enabled and fp.should("bus.drop_event"):
+                    # a watch frame only "drops" when its pipe breaks —
+                    # kill the subscriber's connection instead of
+                    # silently skipping the push (a skipped frame with a
+                    # live stream would be an UNRECOVERABLE gap: the
+                    # client's next event advances last_seq past it).
+                    # The reconnect resumes from last_seq and replays
+                    # this entry from the backlog.
+                    conn.kill()
+                    continue
                 conn.push(protocol.T_WATCH_EVENT, watch_id, entry)
 
         return on_event
@@ -349,7 +370,16 @@ class BusServer:
     # ---- request dispatch ----
 
     def _handle_request(self, conn: _Conn, req_id: int, payload: dict) -> None:
+        from volcano_tpu import faults
+
         op = payload.get("op", "")
+        fp = faults.get_plane()
+        if fp.enabled and fp.should("bus.disconnect"):
+            # server-side partition: the request dies with the
+            # connection; the client fails fast with BusError, redials,
+            # and its resync re-establishes every watch resume-or-relist
+            conn.kill()
+            return
         start = time.perf_counter()
         rec = trace.get_recorder()
         if rec.enabled and "cycle" in payload:
@@ -429,10 +459,18 @@ class BusServer:
             raise ApiError(f"unknown kind {kind!r}")
         watch_id = int(payload["watch_id"])
         resume_seq = payload.get("resume_seq")
+        from volcano_tpu import faults
+
+        fp = faults.get_plane()
         with self.api.locked():
             if resume_seq is not None:
                 oldest_covered = self._seq - len(self._backlog)
-                if payload.get("epoch") != self.epoch or resume_seq < oldest_covered:
+                force_relist = fp.enabled and fp.should("bus.force_relist")
+                if (
+                    force_relist
+                    or payload.get("epoch") != self.epoch
+                    or resume_seq < oldest_covered
+                ):
                     # 410 Gone: this incarnation cannot prove the client
                     # missed nothing — a fresh list is required
                     conn.push(protocol.T_RESP, req_id, {

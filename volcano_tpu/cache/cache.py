@@ -179,8 +179,28 @@ class SchedulerCache(Cache):
         )
 
         #: tasks whose async side effects failed; re-synced from API truth
-        #: (cache.go:687-709 errTasks workqueue).
-        self.err_tasks: List[TaskInfo] = []
+        #: (cache.go:687-709 errTasks workqueue).  Entries are
+        #: ``[task, attempts, next_try_monotonic]``; uids are deduped
+        #: (the reference's workqueue semantics) so a bind burst cannot
+        #: enqueue the same task N times.
+        self.err_tasks: List[list] = []
+        #: uid → [task, quarantined_at_monotonic] for entries that
+        #: exhausted _RESYNC_MAX_RETRIES: requeueing such a poison task
+        #: hot-loop forever would grind the queue (the pre-fix
+        #: behavior).  A quarantined task leaves through fresh API truth
+        #: (any watch event for its pod clears it) or, failing that,
+        #: re-enters the queue after _QUARANTINE_COOLDOWN with its
+        #: attempt budget reset — an unchanged pod gets no watch event,
+        #: so without the cooldown a long bus outage could wedge the
+        #: cached task in Binding permanently.  Visible via the
+        #: ResyncFailed Warning Event and the
+        #: volcano_resync_quarantined_tasks gauge.
+        self.quarantined_tasks: Dict[str, list] = {}
+        #: uids popped from err_tasks whose (blocking, mutex-free) fetch
+        #: is in flight — resync_task dedupes against this too, or a
+        #: concurrent enqueue during the fetch window would mint a
+        #: duplicate entry
+        self._resync_inflight: set = set()
         #: one-shot flag for the "client can't record events" warning
         self._warned_no_events = False
         #: job uid → latest unschedulable writeback digest.  Fit errors
@@ -372,6 +392,7 @@ class SchedulerCache(Cache):
         with self._mutex:
             ti = new_task_info(pod)
             self._mark_task(ti.uid)
+            self._clear_quarantine(ti.uid)
             self._add_task(ti)
 
     def update_pod(self, old_pod: core.Pod, new_pod: core.Pod) -> None:
@@ -383,6 +404,7 @@ class SchedulerCache(Cache):
             # only spec-level changes invalidate it
             if _task_pack_relevant_changed(old_pod, new_pod):
                 self._mark_task(new_ti.uid)
+            self._clear_quarantine(new_ti.uid)
             self._delete_task(old_ti)
             self._add_task(new_ti)
 
@@ -390,6 +412,7 @@ class SchedulerCache(Cache):
         with self._mutex:
             ti = new_task_info(pod)
             self._mark_task(ti.uid)
+            self._clear_quarantine(ti.uid)
             self._delete_task(ti)
 
     # ---- event handlers: nodes (event_handlers.go:255-354) ----
@@ -539,6 +562,10 @@ class SchedulerCache(Cache):
     # ---- snapshot (cache.go:712-790) ----
 
     def snapshot(self) -> ClusterInfo:
+        # backed-off resync entries retry on the cycle boundary — the
+        # natural drain point, and the snapshot then reflects whatever
+        # truth the retries recovered
+        self.process_due_resyncs()
         with self._mutex:
             snapshot = ClusterInfo()
 
@@ -672,6 +699,7 @@ class SchedulerCache(Cache):
 
         def effect():
             try:
+                self._maybe_fail_bind()
                 if self.binder is not None:
                     self.binder.bind(task, hostname)
             except Exception as e:  # noqa: BLE001
@@ -690,6 +718,17 @@ class SchedulerCache(Cache):
                 )
 
         self._run_effect(effect)
+
+    @staticmethod
+    def _maybe_fail_bind() -> None:
+        """``cache.bind_fail`` injection point: a burst of apiserver
+        bind rejections feeding the errTask resync queue, through the
+        exact except path a real rejection takes."""
+        from volcano_tpu import faults
+
+        fp = faults.get_plane()
+        if fp.enabled and fp.should("cache.bind_fail"):
+            raise RuntimeError("fault-injected bind failure")
 
     def bind_batch(self, pairs) -> None:
         """Bind many (task_info, hostname) pairs: the same per-task state
@@ -725,6 +764,7 @@ class SchedulerCache(Cache):
         def effect():
             for task, hostname in bound:
                 try:
+                    self._maybe_fail_bind()
                     if self.binder is not None:
                         self.binder.bind(task, hostname)
                 except Exception as e:  # noqa: BLE001
@@ -860,22 +900,96 @@ class SchedulerCache(Cache):
             self.add_pvc(pvc)
         task.volume_ready = True
 
+    #: resync retry bound + backoff (cache.go:687-709 errTasks uses a
+    #: rate-limited workqueue with MaxRetries; these are that policy)
+    _RESYNC_MAX_RETRIES = 5
+    _RESYNC_BACKOFF_BASE = 0.2  # seconds; exponential per attempt
+    _QUARANTINE_COOLDOWN = 30.0  # seconds before a quarantined task retries
+    #: per-cycle drain bounds: each retry is a blocking get_pod on the
+    #: scheduling thread, so during a bus outage an unbounded drain
+    #: would stall snapshot() by queue-length × RPC-timeout
+    _RESYNC_DRAIN_MAX = 16
+    _RESYNC_DRAIN_BUDGET_S = 1.0
+
     def resync_task(self, task: TaskInfo) -> None:
-        """Requeue for resync from API truth (cache.go:687-709)."""
+        """Requeue for resync from API truth (cache.go:687-709).
+        Deduped by uid; a task already in quarantine stays there until
+        fresh API truth for its pod arrives."""
+        import time as _time
+
         with self._mutex:
-            self.err_tasks.append(task)
+            if (
+                task.uid in self.quarantined_tasks
+                or task.uid in self._resync_inflight
+                or any(e[0].uid == task.uid for e in self.err_tasks)
+            ):
+                return
+            self.err_tasks.append([task, 0, _time.monotonic()])
         if self.client is not None:
             self.process_resync_task()
 
     def process_resync_task(self) -> None:
-        """Re-fetch the pod and rebuild the task (cache.go syncTask)."""
-        with self._mutex:
-            if not self.err_tasks:
-                return
-            task = self.err_tasks.pop(0)
+        """Re-fetch the pod and rebuild the task (cache.go syncTask).
+        One DUE entry per call; a failed fetch backs off exponentially
+        and, past _RESYNC_MAX_RETRIES, quarantines the task with a
+        Warning Event instead of requeueing forever."""
+        import time as _time
+
         if self.client is None:
             return
-        pod = self.client.get_pod(task.namespace, task.name)
+        now = _time.monotonic()
+        with self._mutex:
+            entry = None
+            for i, e in enumerate(self.err_tasks):
+                if e[2] <= now:
+                    entry = self.err_tasks.pop(i)
+                    break
+            if entry is None:
+                return
+            self._resync_inflight.add(entry[0].uid)
+        task, attempts = entry[0], entry[1]
+        try:
+            from volcano_tpu import faults
+
+            fp = faults.get_plane()
+            if fp.enabled and fp.should("cache.resync_fail"):
+                raise RuntimeError("fault-injected resync fetch failure")
+            pod = self.client.get_pod(task.namespace, task.name)
+        except Exception as e:  # noqa: BLE001 — API truth unreachable
+            # note: the requeue/quarantine insertions below happen
+            # BEFORE the finally's inflight release, so dedup never has
+            # a gap where the task is in neither set
+            attempts += 1
+            if attempts >= self._RESYNC_MAX_RETRIES:
+                log.error(
+                    "resync of %s/%s failed %d times (%s); quarantining",
+                    task.namespace, task.name, attempts, e,
+                )
+                self._record_event(
+                    task, "Warning", "ResyncFailed",
+                    f"task state resync failed {attempts} times and was "
+                    f"quarantined pending fresh API truth: {e}",
+                )
+                with self._mutex:
+                    self.quarantined_tasks[task.uid] = [
+                        task, _time.monotonic()
+                    ]
+                    self._update_quarantine_gauge()
+            else:
+                backoff = self._RESYNC_BACKOFF_BASE * (2 ** (attempts - 1))
+                log.warning(
+                    "resync of %s/%s failed (%s); retry %d/%d in %.1fs",
+                    task.namespace, task.name, e, attempts,
+                    self._RESYNC_MAX_RETRIES, backoff,
+                )
+                with self._mutex:
+                    self.err_tasks.append(
+                        [task, attempts, _time.monotonic() + backoff]
+                    )
+            return
+        finally:
+            with self._mutex:
+                self._resync_inflight.discard(task.uid)
         with self._mutex:
             # resync exists precisely because the cached view may have
             # diverged from API truth — the refetched spec can differ,
@@ -884,6 +998,46 @@ class SchedulerCache(Cache):
             self._delete_task(task)
             if pod is not None:
                 self._add_task(new_task_info(pod))
+
+    def process_due_resyncs(self) -> None:
+        """Drain every due resync entry (called once per scheduling
+        cycle from snapshot(), so backed-off entries retry without a
+        dedicated timer thread).  Quarantined tasks past the cooldown
+        re-enter the queue with a fresh attempt budget — a slow retry
+        lane, since an unchanged pod never produces the watch event
+        that is the quarantine's fast exit."""
+        import time as _time
+
+        now = _time.monotonic()
+        with self._mutex:
+            expired = [
+                uid for uid, (task, ts) in self.quarantined_tasks.items()
+                if now - ts >= self._QUARANTINE_COOLDOWN
+            ]
+            for uid in expired:
+                task, _ts = self.quarantined_tasks.pop(uid)
+                self.err_tasks.append([task, 0, now])
+            if expired:
+                self._update_quarantine_gauge()
+        drain_deadline = now + self._RESYNC_DRAIN_BUDGET_S
+        for _ in range(min(len(self.err_tasks), self._RESYNC_DRAIN_MAX)):
+            with self._mutex:
+                due = any(e[2] <= _time.monotonic() for e in self.err_tasks)
+            if not due or _time.monotonic() >= drain_deadline:
+                return
+            self.process_resync_task()
+
+    def _update_quarantine_gauge(self) -> None:
+        # caller holds the mutex
+        from volcano_tpu.metrics import metrics
+
+        metrics.update_resync_quarantined(len(self.quarantined_tasks))
+
+    def _clear_quarantine(self, uid: str) -> None:
+        """Fresh API truth for a quarantined task's pod arrived through
+        the watch — the quarantine's exit condition."""
+        if self.quarantined_tasks.pop(uid, None) is not None:
+            self._update_quarantine_gauge()
 
     # ---- status writeback ----
 

@@ -396,6 +396,30 @@ def _describe_podgroup(vc: VolcanoClient, args, out) -> int:
 
 # ---- trace subcommands (volcano_tpu/trace) ----
 
+def _faults_validate(vc: VolcanoClient, args, out) -> int:
+    """Parse a fault schedule and print it normalized — catches a
+    typo'd point name or malformed modifier before it reaches a daemon
+    flag (where it would be a startup error at deploy time)."""
+    from volcano_tpu.faults import parse_faults
+
+    spec = parse_faults(args.spec)  # ValueError → main's error path
+    print(f"seed: {spec.seed}", file=out)
+    if not spec.rules:
+        print("no fault rules (plane would be a no-op)", file=out)
+    for rule in spec.rules.values():
+        mods = []
+        if rule.count is not None:
+            mods.append(f"at most {rule.count} firings")
+        if rule.after:
+            mods.append(f"after {rule.after} evaluations")
+        if rule.ms:
+            mods.append(f"{rule.ms:g} ms")
+        suffix = f" ({', '.join(mods)})" if mods else ""
+        print(f"  {rule.point}: p={rule.probability:g}{suffix}", file=out)
+    print(f"normalized: {spec.format()}", file=out)
+    return 0
+
+
 def _trace_record(vc: VolcanoClient, args, out) -> int:
     """Record synthetic scheduling cycles into a journal: per cycle, the
     event timeline plus (sampled) the packed session + kernel assignment
@@ -602,6 +626,17 @@ def build_parser() -> argparse.ArgumentParser:
     te.add_argument("--cycle", type=int, default=None)
     te.add_argument("--out", "-o", default="", help="output file (default stdout)")
 
+    faults_p = sub.add_parser(
+        "faults",
+        description="fault-injection schedules (volcano_tpu.faults)",
+    ).add_subparsers(dest="cmd", required=True)
+    fv = faults_p.add_parser(
+        "validate",
+        description="parse a --faults/VTPU_FAULTS spec and print the "
+        "normalized schedule (rejects typos before a chaos run)",
+    )
+    fv.add_argument("--spec", "-s", required=True)
+
     return parser
 
 
@@ -619,6 +654,7 @@ _HANDLERS = {
     ("queue", "delete"): _queue_delete,
     ("describe", "job"): _describe_job,
     ("describe", "podgroup"): _describe_podgroup,
+    ("faults", "validate"): _faults_validate,
     ("trace", "record"): _trace_record,
     ("trace", "replay"): _trace_replay,
     ("trace", "diff"): _trace_diff,

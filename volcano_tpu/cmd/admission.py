@@ -13,6 +13,7 @@ import time
 
 from volcano_tpu.admission import register_webhooks
 from volcano_tpu.client import APIServer  # noqa: F401 — the in-process default
+from volcano_tpu.cmd.daemon import apply_faults
 from volcano_tpu.cmd.scheduler import add_common_args, resolve_bus
 from volcano_tpu.serving import ServingServer
 from volcano_tpu.utils.logging import get_logger
@@ -51,6 +52,7 @@ def main(argv=None) -> int:
     parser.add_argument("--gate-pods", action="store_true")
     add_common_args(parser)
     args = parser.parse_args(argv)
+    apply_faults(args.faults)
     daemon = AdmissionDaemon(
         resolve_bus(args.bus),
         gate_pods=args.gate_pods,

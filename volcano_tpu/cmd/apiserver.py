@@ -106,7 +106,15 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--seed-node-cpu", default="8")
     parser.add_argument("--seed-node-mem", default="32Gi")
+    parser.add_argument(
+        "--faults", default="",
+        help="deterministic fault-injection schedule (bus.* points fire "
+        "server-side here; same grammar as VTPU_FAULTS)",
+    )
     args = parser.parse_args(argv)
+    from volcano_tpu.cmd.daemon import apply_faults
+
+    apply_faults(args.faults)
 
     daemon = ApiServerDaemon(
         listen_host=args.listen_host,

@@ -27,7 +27,16 @@ def main(argv=None) -> int:
         "--warmup", action="store_true",
         help="compile the headline-bucket kernels before serving",
     )
+    parser.add_argument(
+        "--faults", default="",
+        help="deterministic fault-injection schedule (compute.* and "
+        "device.* points fire in this process; same grammar as "
+        "VTPU_FAULTS)",
+    )
     args = parser.parse_args(argv)
+    from volcano_tpu.cmd.daemon import apply_faults
+
+    apply_faults(args.faults)
 
     if args.warmup:
         # populate the jit cache so the first real session doesn't pay

@@ -11,6 +11,7 @@ import argparse
 
 from volcano_tpu.client import APIServer  # noqa: F401 — the in-process default
 from volcano_tpu.cmd.daemon import BaseDaemon, serve_forever
+from volcano_tpu.cmd.daemon import apply_faults
 from volcano_tpu.cmd.scheduler import add_common_args, resolve_bus
 from volcano_tpu.controllers import (
     GarbageCollector,
@@ -49,6 +50,7 @@ def main(argv=None) -> int:
     parser.add_argument("--period", type=float, default=0.2)
     add_common_args(parser)
     args = parser.parse_args(argv)
+    apply_faults(args.faults)
     return serve_forever(
         ControllersDaemon(
             resolve_bus(args.bus),

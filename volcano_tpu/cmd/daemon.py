@@ -114,6 +114,23 @@ class BaseDaemon:
         self.serving.stop()
 
 
+def apply_faults(spec: str) -> None:
+    """``--faults`` → the process-global fault plane (a parse error is
+    a clean exit: a typo'd schedule must not run a different chaos
+    plan).  An empty flag leaves VTPU_FAULTS env resolution intact.
+    Lives here — not in cmd.scheduler — so store-only daemons
+    (vtpu-apiserver, vtpu-compute-plane) don't drag the scheduler
+    stack in for a flag helper."""
+    if not spec:
+        return
+    from volcano_tpu import faults
+
+    try:
+        faults.configure(spec)
+    except ValueError as e:
+        raise SystemExit(f"--faults: {e}") from e
+
+
 def serve_forever(daemon: BaseDaemon) -> int:
     """Blocking main body shared by the binaries."""
     daemon.start()

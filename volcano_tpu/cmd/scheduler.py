@@ -12,7 +12,7 @@ import argparse
 
 from volcano_tpu.cache import SchedulerCache
 from volcano_tpu.client import APIServer, SchedulerClient
-from volcano_tpu.cmd.daemon import BaseDaemon, serve_forever
+from volcano_tpu.cmd.daemon import BaseDaemon, apply_faults, serve_forever
 from volcano_tpu.scheduler.scheduler import Scheduler
 
 
@@ -39,6 +39,7 @@ class SchedulerDaemon(BaseDaemon):
         scheduler_name: str = "volcano-tpu",
         gc_quiesce_period: int = 0,
         snapshot_reuse: bool = False,
+        cycle_deadline_ms=None,
         **daemon_kw,
     ):
         # /explain reads self.cache lazily (set right below) — the
@@ -56,6 +57,7 @@ class SchedulerDaemon(BaseDaemon):
         self.scheduler = Scheduler(
             self.cache, scheduler_conf_path=scheduler_conf,
             period=schedule_period, gc_quiesce_period=gc_quiesce_period,
+            cycle_deadline_ms=cycle_deadline_ms,
         )
 
     def _on_start(self) -> None:
@@ -80,6 +82,13 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
         "--enable-debug-stacks", action="store_true",
         help="serve /debug/stacks to non-loopback clients (forensics; "
         "stack dumps expose internals — default loopback-only)",
+    )
+    parser.add_argument(
+        "--faults", default="",
+        help="deterministic fault-injection schedule, e.g. "
+        "'seed=42;bus.disconnect=0.05;compute.crash=0.1:count=2' "
+        "(volcano_tpu.faults; same grammar as VTPU_FAULTS — chaos "
+        "testing only, never set in production)",
     )
 
 
@@ -117,6 +126,12 @@ def main(argv=None) -> int:
         "first cycle (first compile is ~20-40s on TPU; same flag as "
         "vtpu-compute-plane)",
     )
+    parser.add_argument(
+        "--cycle-deadline-ms", type=float, default=0,
+        help="cycle watchdog: abandon a device phase that would overrun "
+        "this wall-clock budget and complete the cycle on the host "
+        "scoring path (0 = off)",
+    )
     # Host-fallback node subsampling (options.go:38-40, honored by the
     # host predicate loop via scheduler_helper's feasible-node budget).
     # The device kernels score all nodes at once, so these only matter
@@ -139,6 +154,7 @@ def main(argv=None) -> int:
     )
     add_common_args(parser)
     args = parser.parse_args(argv)
+    apply_faults(args.faults)
 
     from volcano_tpu.scheduler import util as sched_util
 
@@ -174,6 +190,7 @@ def main(argv=None) -> int:
             scheduler_name=args.scheduler_name,
             gc_quiesce_period=args.gc_quiesce_period,
             snapshot_reuse=args.snapshot_reuse,
+            cycle_deadline_ms=args.cycle_deadline_ms or None,
             listen_host=args.listen_host,
             listen_port=args.listen_port,
             leader_elect=args.leader_elect,

@@ -296,6 +296,41 @@ def update_bus_server_watchers(count: int) -> None:
     registry.set_gauge(f"{_NAMESPACE}_bus_server_watchers", {}, count)
 
 
+# ---- fault plane + graceful degradation (volcano_tpu/faults) ----
+# volcano_executor_fallbacks_total is the demotion audit: every time an
+# executor path degrades to a lower rung (pallas→blocked, native→
+# xla-scan, remote→local, device→host) one count lands here with the
+# cause, so a silent permanent demotion is impossible.
+
+def register_executor_fallback(from_: str, to: str, cause: str) -> None:
+    """cause ∈ {error, circuit-open, deadline, corrupt-output,
+    unhealthy}."""
+    registry.inc(
+        f"{_NAMESPACE}_executor_fallbacks_total",
+        {"from": from_, "to": to, "cause": cause},
+    )
+
+
+def update_circuit_breaker_state(executor: str, value: float) -> None:
+    """0 = closed, 0.5 = half-open (probing), 1 = open (tripped)."""
+    registry.set_gauge(
+        f"{_NAMESPACE}_circuit_breaker_open", {"executor": executor}, value
+    )
+
+
+def register_fault_injected(point: str) -> None:
+    """One count per fault-plane firing — lets a chaos run's metrics be
+    cross-checked against its trace journal."""
+    registry.inc(f"{_NAMESPACE}_faults_injected_total", {"point": point})
+
+
+def update_resync_quarantined(count: int) -> None:
+    """volcano_resync_quarantined_tasks: tasks whose resync exhausted
+    its bounded retries and now sit quarantined awaiting fresh API
+    truth (cache.SchedulerCache poison-task handling)."""
+    registry.set_gauge(f"{_NAMESPACE}_resync_quarantined_tasks", {}, count)
+
+
 # ---- TPU-build additions: per-kernel phase timings ----
 
 def update_kernel_duration(phase: str, seconds: float) -> None:

@@ -125,10 +125,20 @@ def make_inner_step(tracked, base_t, alloc_t, maxt_t, real, tolerance,
 
 
 def gang_fixpoint(run_pass, task_job, job_min_available, job_ready_count,
-                  n_tasks, t_total, gang_rounds):
+                  n_tasks, t_total, gang_rounds, discard_unstable=False):
     """Adaptive host-side gang commit/discard loop (run_packed protocol),
     shared by the blocked and sharded wrappers: ``run_pass(active)`` →
-    (chosen, job_assigned); stops as soon as the active set is stable."""
+    (chosen, job_assigned); stops as soon as the active set is stable.
+
+    ``gang_rounds`` bounds the cascade; an unsettled fixpoint ships the
+    last round's commits (individually valid placements computed while
+    later-discarded jobs still held resources).  ``discard_unstable``
+    opts into the reference's Statement semantics instead
+    (statement.go:309-337 discards operations until the set is stable):
+    the loop runs to the true fixpoint, ignoring the round bound.
+    Termination is structural — every non-stable round STRICTLY shrinks
+    the active set (next_active = active & ready-mask ≠ active), so the
+    fixpoint arrives within min(n_jobs, n_tasks)+1 passes."""
     active = np.zeros(t_total, dtype=bool)
     active[:n_tasks] = True
     min_avail = job_min_available.astype(np.int64)
@@ -136,13 +146,17 @@ def gang_fixpoint(run_pass, task_job, job_min_available, job_ready_count,
 
     chosen_np = np.full(t_total, -1, dtype=np.int32)
     committed = np.zeros(t_total, dtype=bool)
-    for _ in range(gang_rounds):
+    rounds = 0
+    while True:
         chosen, job_assigned = run_pass(jnp.asarray(active))
         chosen_np = np.asarray(chosen)
         ready = np.asarray(job_assigned, dtype=np.int64) + ready_count >= min_avail
         committed = ready[task_job] & (chosen_np >= 0)
         next_active = active & ready[task_job]
+        rounds += 1
         if (next_active == active).all():
+            break
+        if not discard_unstable and rounds >= gang_rounds:
             break
         active = next_active
     return np.where(committed & active, chosen_np, -1)[:n_tasks]
@@ -398,6 +412,7 @@ def run_packed_blocked(
     gang_rounds: int = 3,
     block_size: int = 64,
     top_k: int = 8,
+    discard_unstable: bool = False,
 ) -> np.ndarray:
     """Host wrapper with the adaptive gang fixpoint (same protocol as
     kernels.run_packed) on the blocked pass."""
@@ -448,4 +463,5 @@ def run_packed_blocked(
         snap.n_tasks,
         T_blk,
         gang_rounds,
+        discard_unstable=discard_unstable,
     )

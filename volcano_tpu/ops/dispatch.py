@@ -16,6 +16,8 @@ tests/test_blocked.py and tests/test_pallas.py):
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from volcano_tpu.ops.kernels import (
@@ -41,6 +43,55 @@ _SMEM_BUDGET = 768 * 1024
 #: node count above which a multi-device session shards the node axis
 #: instead of running the single-chip blocked formulation
 _SHARD_MIN_NODES = 2_048
+
+#: degradation ladder: which rung a failing/tripped executor falls to.
+#: blocked and xla-scan are the floor (plain XLA formulations with no
+#: exotic lowering) — they carry no breaker and their failures propagate.
+_FALLBACK = {"native": "xla-scan", "pallas": "blocked", "sharded": "blocked"}
+
+
+def _breaker(name: str):
+    """Executor breaker: 3 consecutive failures open it, half-open
+    re-probe after 30s promotes the executor back on success."""
+    from volcano_tpu.faults.breaker import get_breaker
+
+    return get_breaker(name, failure_threshold=3, cooldown_s=30.0)
+
+
+def gang_discard_unstable() -> bool:
+    """Opt-in reference Statement semantics for an unsettled gang
+    cascade (VERDICT weak #6): ``VTPU_GANG_DISCARD_UNSTABLE=1`` makes
+    the host gang loops discard until stable instead of shipping the
+    last bounded round's commits.  Routes around the Pallas/native
+    formulations (their cascades are fixed-round inside the kernel).
+    Same accepted values as the repo's other env flags
+    (utils/asserts.py) — 'false'/'no'/'off' mean OFF."""
+    return os.environ.get("VTPU_GANG_DISCARD_UNSTABLE", "").lower() in (
+        "1", "true", "yes",
+    )
+
+
+def _assignment_valid(snap: PackedSnapshot, out) -> bool:
+    """Cheap sanity gate on an upper-rung executor's output: the right
+    length and every value a real node index or -1.  A kernel that
+    silently produced garbage (NaN score planes argmax to arbitrary
+    indices) degrades like a raised error instead of binding tasks to
+    nonexistent nodes."""
+    arr = np.asarray(out)
+    if arr.ndim != 1 or arr.shape[0] < snap.n_tasks:
+        return False
+    head = arr[: snap.n_tasks]
+    return bool(((head >= -1) & (head < snap.n_nodes)).all())
+
+
+class _CorruptOutput(RuntimeError):
+    """Upper-rung executor returned an invalid assignment."""
+
+
+class _PhaseAbandoned(RuntimeError):
+    """This dispatch runs on a watchdog worker whose cycle already
+    completed on the host path — unwind without touching breakers,
+    fallback counters, last-executor notes, or running any fallback."""
 
 
 def _tpu_available() -> bool:
@@ -147,11 +198,22 @@ def run_preempt_auto(pk, weights: ScoreWeights = DEFAULT_WEIGHTS):
     """PreemptPacked → (evicted, pipelined), fastest exact path: pallas
     when eligible, degrading to the dense formulation on runtime
     failure.  The single copy of the preempt dispatch — used in-process,
-    by the jax-preempt action, and by the compute-plane sidecar."""
-    from volcano_tpu import trace
+    by the jax-preempt action, and by the compute-plane sidecar.  The
+    pallas rung sits behind a circuit breaker: repeated failures stop
+    re-attempting (and re-paying the failure latency) every cycle; a
+    half-open probe later promotes it back."""
+    from volcano_tpu import faults, trace
+    from volcano_tpu.metrics import metrics
     from volcano_tpu.ops.preempt_pack import preempt_dense
 
     executor = select_preempt_executor(pk)
+    if executor == "pallas" and not _breaker("preempt-pallas").allow():
+        # demote BEFORE the trace event below, so the journal names the
+        # executor that actually runs during the open window
+        metrics.register_executor_fallback(
+            "preempt-pallas", "dense", "circuit-open"
+        )
+        executor = "dense"
     rec = trace.get_recorder()
     if rec.enabled:
         rec.event(
@@ -162,11 +224,21 @@ def run_preempt_auto(pk, weights: ScoreWeights = DEFAULT_WEIGHTS):
     if executor == "pallas":
         from volcano_tpu.ops.preempt_pallas import run_preempt_pallas
 
+        br = _breaker("preempt-pallas")
+        fp = faults.get_plane()
         try:
-            return run_preempt_pallas(pk, weights=weights)
+            if fp.enabled and fp.should("device.lowering"):
+                raise RuntimeError("fault-injected lowering failure")
+            out = run_preempt_pallas(pk, weights=weights)
+            br.record_success()
+            return out
         except Exception as e:  # noqa: BLE001 — degrade, don't abort
             from volcano_tpu.utils.logging import get_logger
 
+            br.record_failure(str(e))
+            metrics.register_executor_fallback(
+                "preempt-pallas", "dense", "error"
+            )
             get_logger(__name__).error(
                 "pallas preempt failed (%s); dense fallback", e
             )
@@ -200,44 +272,110 @@ def run_packed_auto(
 
     Dispatches on :func:`select_executor` — the single copy of the
     decision tree — so what runs always matches what callers (e.g.
-    bench.py's ``executor`` field) report."""
+    bench.py's ``executor`` field) report.  Upper rungs (native, pallas,
+    sharded) sit behind per-executor circuit breakers: a tripped rung is
+    skipped without being attempted (no failure latency every cycle)
+    until its half-open probe succeeds; every demotion counts in
+    ``volcano_executor_fallbacks_total`` and the outputs of upper rungs
+    pass a validity gate so silently-corrupt kernels degrade like raised
+    errors."""
     executor = select_executor(snap, weights)
-    from volcano_tpu import trace
+    from volcano_tpu import faults, trace
+    from volcano_tpu.metrics import metrics
 
+    fp = faults.get_plane()
+    discard = gang_discard_unstable()
+    if discard and executor in ("pallas", "native"):
+        # these formulations run their gang cascade fixed-round inside
+        # the kernel; the discard-until-stable loop is host-driven
+        executor = "blocked" if executor == "pallas" else "xla-scan"
+    if executor in _FALLBACK and not _breaker(executor).allow():
+        metrics.register_executor_fallback(
+            executor, _FALLBACK[executor], "circuit-open"
+        )
+        executor = _FALLBACK[executor]
     rec = trace.get_recorder()
     if rec.enabled:
         rec.event(
             "dispatch:allocate", "kernel",
             executor=executor, tasks=snap.n_tasks, nodes=snap.n_nodes,
         )
+    if fp.enabled and fp.should("device.slow"):
+        import time
+
+        time.sleep(fp.param_ms("device.slow") / 1e3)
     _note(executor)
+
+    def attempt(run):
+        """One upper-rung attempt under its breaker: injected lowering
+        failures, the corrupt-output gate, and success/failure
+        accounting all live here once."""
+        from volcano_tpu.faults import watchdog
+
+        br = _breaker(executor)
+        if fp.enabled and fp.should("device.lowering"):
+            raise RuntimeError("fault-injected lowering failure")
+        out = run()
+        if watchdog.abandoned():
+            # the cycle watchdog gave up on this worker mid-run: the
+            # cycle already completed on the host path — this (late)
+            # result is garbage to it, and recording a verdict now
+            # would race the next live cycle's breaker state
+            raise _PhaseAbandoned(executor)
+        if fp.enabled and fp.should("device.nan"):
+            out = np.full(
+                np.asarray(out).shape, np.iinfo(np.int32).max, dtype=np.int32
+            )
+        if not _assignment_valid(snap, out):
+            raise _CorruptOutput(f"{executor} returned an invalid assignment")
+        br.record_success()
+        return out
+
+    def degrade(e: Exception):
+        from volcano_tpu.faults import watchdog
+        from volcano_tpu.utils.logging import get_logger
+
+        if isinstance(e, _PhaseAbandoned) or watchdog.abandoned():
+            # abandoned worker: no breaker verdict, no fallback count,
+            # no _note overwrite, and — by raising before the caller's
+            # fallback line — no duplicate fallback allocate competing
+            # with the next cycle for the device
+            raise _PhaseAbandoned(executor)
+        fallback = _FALLBACK[executor]
+        _breaker(executor).record_failure(str(e))
+        metrics.register_executor_fallback(
+            executor, fallback,
+            "corrupt-output" if isinstance(e, _CorruptOutput) else "error",
+        )
+        get_logger(__name__).error(
+            "%s allocate failed (%s); %s fallback", executor, e, fallback
+        )
+        _note(fallback)
+
     if executor == "native":
         from volcano_tpu import native
 
         try:
-            return native.baseline_allocate(snap, gang_rounds=gang_rounds)
-        except RuntimeError:
+            return attempt(
+                lambda: native.baseline_allocate(snap, gang_rounds=gang_rounds)
+            )
+        except (RuntimeError, ValueError) as e:
             # Native executor hit an internal error mid-session — degrade
             # to the exact XLA scan rather than failing the session.
-            _note("xla-scan")
+            degrade(e)
             return run_packed(snap, weights=weights, gang_rounds=gang_rounds)
     if executor == "pallas":
         from volcano_tpu.ops.blocked import run_packed_blocked
         from volcano_tpu.ops.pallas_session import run_packed_pallas
 
         try:
-            return run_packed_pallas(
-                snap, weights=weights, gang_rounds=gang_rounds
+            return attempt(
+                lambda: run_packed_pallas(
+                    snap, weights=weights, gang_rounds=gang_rounds
+                )
             )
         except Exception as e:  # noqa: BLE001 — e.g. VMEM overflow at lowering
-            # Degrade to the exact blocked formulation, mirroring the
-            # native-path RuntimeError degradation below (ADVICE r2).
-            from volcano_tpu.utils.logging import get_logger
-
-            get_logger(__name__).error(
-                "pallas allocate failed (%s); blocked fallback", e
-            )
-            _note("blocked")
+            degrade(e)
             return run_packed_blocked(
                 snap, weights=weights, gang_rounds=gang_rounds
             )
@@ -253,24 +391,29 @@ def run_packed_auto(
         # run_packed_sharded; the mesh is 1-D over all devices
         mesh = Mesh(np.array(devices), ("nodes",))
         try:
-            return run_packed_sharded(
-                snap, mesh, weights=weights, gang_rounds=gang_rounds
+            return attempt(
+                lambda: run_packed_sharded(
+                    snap, mesh, weights=weights, gang_rounds=gang_rounds,
+                    discard_unstable=discard,
+                )
             )
         except Exception as e:  # noqa: BLE001 — degrade like the other paths
-            from volcano_tpu.utils.logging import get_logger
-
-            get_logger(__name__).error(
-                "sharded allocate failed (%s); blocked fallback", e
-            )
-            _note("blocked")
+            degrade(e)
             return run_packed_blocked(
-                snap, weights=weights, gang_rounds=gang_rounds
+                snap, weights=weights, gang_rounds=gang_rounds,
+                discard_unstable=discard,
             )
     if executor == "blocked":
         from volcano_tpu.ops.blocked import run_packed_blocked
 
-        return run_packed_blocked(snap, weights=weights, gang_rounds=gang_rounds)
-    return run_packed(snap, weights=weights, gang_rounds=gang_rounds)
+        return run_packed_blocked(
+            snap, weights=weights, gang_rounds=gang_rounds,
+            discard_unstable=discard,
+        )
+    return run_packed(
+        snap, weights=weights, gang_rounds=gang_rounds,
+        discard_unstable=discard,
+    )
 
 
 def warmup_kernels(n_tasks: int = 4096, n_nodes: int = 1024,

@@ -29,12 +29,20 @@ _RETRY_PERIOD = 5.0
 
 class _Remote:
     def __init__(self, path: str):
+        from volcano_tpu.faults.breaker import get_breaker
         from volcano_tpu.serving.compute_plane import ComputePlaneClient
 
         self.client = ComputePlaneClient(path)
         self.path = path
         self.healthy = True
         self.last_probe = 0.0
+        #: threshold 1: one failed session is enough — the in-process
+        #: fallback is exact, so there is no reason to pay a second
+        #: failure latency before demoting.  The breaker mirrors the
+        #: probe state into /healthz (degraded) and the breaker gauge.
+        self.breaker = get_breaker(
+            "compute-plane", failure_threshold=1, cooldown_s=_RETRY_PERIOD
+        )
 
     def usable(self) -> bool:
         if self.healthy:
@@ -45,8 +53,20 @@ class _Remote:
         self.last_probe = now
         self.healthy = self.client.health()
         if self.healthy:
+            self.breaker.record_success()
             log.info("compute plane %s back up", self.path)
         return self.healthy
+
+    def mark_unhealthy(self, error: str) -> None:
+        """Session-loss handling: demote the route AND drop the
+        connection — a restarted (or abandoned mid-read) sidecar shares
+        no session state with us, so the delta handshake must restart
+        from a full frame (ComputePlaneClient.close clears the acked
+        revisions)."""
+        self.healthy = False
+        self.last_probe = time.monotonic()
+        self.breaker.record_failure(error)
+        self.client.close()
 
 
 _UNSET = object()  # env-derived default; distinct from "explicitly off"
@@ -138,6 +158,9 @@ def execute_allocate(
     against the snapshot it already holds — same request, no second
     round trip; a pre-explain sidecar returns no counts and the local
     reduction fills in."""
+    from volcano_tpu.faults import watchdog
+    from volcano_tpu.faults.watchdog import CycleDeadlineExceeded
+    from volcano_tpu.metrics import metrics
     from volcano_tpu.ops.dispatch import run_packed_auto
     from volcano_tpu.ops.kernels import DEFAULT_WEIGHTS
 
@@ -150,6 +173,10 @@ def execute_allocate(
     # default-configured sessions may route remotely, or the sidecar
     # would silently run different parameters than the fallback
     global _last_route, _last_explain_counts, _last_explain_ms
+    # cleared up front: an aborted call (deadline, error) must not leave
+    # a previous session's counts readable as this session's
+    _last_explain_counts = None
+    _last_explain_ms = None
     if (
         remote is not None
         and weights == DEFAULT_WEIGHTS
@@ -158,10 +185,12 @@ def execute_allocate(
     ):
         try:
             with rec.span("executor:remote-allocate", "kernel"):
-                out = remote.client.allocate(snap, explain=explain)
+                out = watchdog.run_with_deadline(
+                    lambda: remote.client.allocate(snap, explain=explain),
+                    watchdog.remaining_s(),
+                    "remote-allocate",
+                )
             _last_route = "remote"
-            _last_explain_counts = None
-            _last_explain_ms = None
             if explain:
                 counts = remote.client.last_reason_counts
                 if counts is not None:
@@ -171,15 +200,29 @@ def execute_allocate(
                     # reduction as the local path
                     _maybe_explain(snap, out)
             return out
+        except CycleDeadlineExceeded as e:
+            # budget gone mid-RPC: the abandoned read desynced the
+            # connection — drop it (full-frame re-handshake later) and
+            # fall through; the local wrapper below raises immediately
+            # on the exhausted budget, handing the cycle to the host
+            # path in jax-allocate, which records the ONE
+            # device→host/deadline fallback count for this cycle
+            remote.mark_unhealthy(str(e))
+            rec.event("executor:remote-fallback", "kernel", error=str(e))
+            log.error("compute plane allocate overran the cycle deadline")
         except Exception as e:  # noqa: BLE001 — degrade to in-process
-            remote.healthy = False
-            remote.last_probe = time.monotonic()
+            remote.mark_unhealthy(str(e))
+            metrics.register_executor_fallback("remote", "local", "error")
             rec.event("executor:remote-fallback", "kernel", error=str(e))
             log.error(
                 "compute plane allocate failed (%s); in-process fallback", e
             )
     _last_route = "local"
-    out = run_packed_auto(snap, weights=weights, gang_rounds=gang_rounds)
+    out = watchdog.run_with_deadline(
+        lambda: run_packed_auto(snap, weights=weights, gang_rounds=gang_rounds),
+        watchdog.remaining_s(),
+        "local-allocate",
+    )
     if explain:
         _maybe_explain(snap, out)
     else:
@@ -189,8 +232,11 @@ def execute_allocate(
 
 
 def execute_preempt(pk) -> Tuple[np.ndarray, np.ndarray]:
-    """PreemptPacked → (evicted, pipelined), via sidecar when configured."""
+    """PreemptPacked → (evicted, pipelined), via sidecar when configured.
+    The cycle watchdog does not bound this phase — preempt has no
+    host-completion seam to hand an abandoned device pass to."""
     from volcano_tpu import trace
+    from volcano_tpu.metrics import metrics
     from volcano_tpu.ops.dispatch import run_preempt_auto
 
     rec = trace.get_recorder()
@@ -200,8 +246,8 @@ def execute_preempt(pk) -> Tuple[np.ndarray, np.ndarray]:
             with rec.span("executor:remote-preempt", "kernel"):
                 return remote.client.preempt(pk)
         except Exception as e:  # noqa: BLE001
-            remote.healthy = False
-            remote.last_probe = time.monotonic()
+            remote.mark_unhealthy(str(e))
+            metrics.register_executor_fallback("remote", "local", "error")
             rec.event("executor:remote-fallback", "kernel", error=str(e))
             log.error(
                 "compute plane preempt failed (%s); in-process fallback", e

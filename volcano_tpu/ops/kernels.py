@@ -560,11 +560,18 @@ def run_packed(
     snap: PackedSnapshot,
     weights: ScoreWeights = DEFAULT_WEIGHTS,
     gang_rounds: int = 3,
+    discard_unstable: bool = False,
 ) -> np.ndarray:
     """Host wrapper: PackedSnapshot → assignment[T] (np.int32), with the
     gang fixpoint driven host-side (adaptive: stops as soon as the active
     set is stable, which for well-provisioned sessions is after round 1 —
-    identical outcome to the fixed-round schedule_session)."""
+    identical outcome to the fixed-round schedule_session).
+
+    ``discard_unstable`` opts into the reference's Statement semantics
+    for an unsettled cascade (statement.go:309-337: discard until
+    stable): the loop ignores the ``gang_rounds`` bound and runs to the
+    true fixpoint.  Terminates structurally — every non-stable round
+    strictly shrinks the active set."""
     T = snap.task_resreq.shape[0]
     active = np.zeros(T, dtype=bool)
     active[: snap.n_tasks] = True
@@ -607,13 +614,17 @@ def run_packed(
 
     chosen_np = np.full(T, -1, dtype=np.int32)
     committed = np.zeros(T, dtype=bool)
-    for _ in range(gang_rounds):
+    rounds = 0
+    while True:
         chosen, job_assigned = schedule_pass(*dev, jnp.asarray(active), weights=weights)
         chosen_np = np.asarray(chosen)
         ready = np.asarray(job_assigned, dtype=np.int64) + ready_count >= min_avail
         committed = ready[task_job] & (chosen_np >= 0)
         next_active = active & ready[task_job]
+        rounds += 1
         if (next_active == active).all():
+            break
+        if not discard_unstable and rounds >= gang_rounds:
             break
         active = next_active
 

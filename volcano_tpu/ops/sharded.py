@@ -355,6 +355,7 @@ def run_packed_sharded(
     gang_rounds: int = 3,
     block_size: int = 64,
     top_k: int = 8,
+    discard_unstable: bool = False,
 ) -> np.ndarray:
     """Host wrapper: PackedSnapshot → assignment[T] on a device mesh,
     with the adaptive gang fixpoint (same protocol as run_packed_blocked)
@@ -401,4 +402,5 @@ def run_packed_sharded(
         snap.n_tasks,
         T_blk,
         gang_rounds,
+        discard_unstable=discard_unstable,
     )

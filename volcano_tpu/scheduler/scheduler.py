@@ -37,8 +37,19 @@ class Scheduler:
         scheduler_conf_path: str = "",
         period: float = DEFAULT_SCHEDULE_PERIOD,
         gc_quiesce_period: int = 0,
+        cycle_deadline_ms: Optional[float] = None,
     ):
         self.cache = cache
+        #: cycle watchdog (--cycle-deadline-ms): arms a process-global
+        #: wall-clock budget; the device phase (ops/executor) runs under
+        #: the remaining budget and an overrun completes the cycle on
+        #: the host path.  None leaves the global watchdog untouched
+        #: (so auxiliary Scheduler instances can't disarm a configured
+        #: daemon's deadline).
+        if cycle_deadline_ms is not None:
+            from volcano_tpu.faults import watchdog
+
+            watchdog.configure_deadline(cycle_deadline_ms)
         self.scheduler_conf_path = scheduler_conf_path
         self.period = period
         #: every N cycles, collect + freeze gen-2 survivors so steady-state
@@ -78,6 +89,9 @@ class Scheduler:
 
     def run_once(self) -> None:
         """scheduler.go:71-87."""
+        from volcano_tpu.faults import watchdog
+
+        watchdog.begin_cycle()  # stamp the cycle-deadline budget
         rec = trace.get_recorder()
         cid = rec.begin_cycle()
         # cycle correlation id: the recorder's cycle id when tracing,

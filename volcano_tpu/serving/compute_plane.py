@@ -300,6 +300,8 @@ def _alloc_response(snap, meta: Dict, assignment: np.ndarray) -> bytes:
 
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):  # one connection, many requests
+        from volcano_tpu import faults
+
         while True:
             try:
                 mtype, payload = _recv_frame(self.request)
@@ -308,6 +310,34 @@ class _Handler(socketserver.BaseRequestHandler):
             except ValueError as e:
                 _send_frame(self.request, T_ERROR, str(e).encode())
                 return
+            fp = faults.get_plane()
+            if fp.enabled and mtype != T_PING:
+                # named seams of the sidecar failure modes, evaluated on
+                # real requests only (health probes stay honest — a
+                # crashed sidecar's probe genuinely fails, an injected
+                # one must not fake probe results)
+                if fp.should("compute.crash"):
+                    # sidecar dies mid-session: the peer sees a closed
+                    # socket with its request unanswered
+                    try:
+                        self.request.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    self.request.close()
+                    return
+                if fp.should("compute.corrupt"):
+                    # garbage on the wire: the client's frame parser
+                    # rejects the magic and tears the connection down
+                    try:
+                        self.request.sendall(b"GARBAGE-NOT-A-VTPU-FRAME")
+                    except OSError:
+                        return
+                    continue
+                if mtype == T_ALLOC_DELTA_REQ and fp.should("compute.need_full"):
+                    # forced session loss: pretend the base revision is
+                    # gone so the client re-handshakes with a full frame
+                    _send_frame(self.request, T_NEED_FULL, b"")
+                    continue
             try:
                 if mtype == T_PING:
                     _send_frame(self.request, T_PONG, b"")
@@ -410,8 +440,14 @@ class ComputePlaneClient:
         self._lock = threading.Lock()
         #: session revision the SERVER is known to hold, per cache_key —
         #: a delta frame is only worth sending when the server's copy is
-        #: exactly the delta's base revision
+        #: exactly the delta's base revision.  Guarded by _state_lock
+        #: together with _session_gen: close() bumps the generation, so
+        #: an allocate() the cycle watchdog abandoned (which may
+        #: complete AFTER a close cleared the acks) cannot re-insert an
+        #: ack the restarted sidecar does not hold.
         self._acked: Dict[str, int] = {}
+        self._session_gen = 0
+        self._state_lock = threading.Lock()
         #: set after an "unknown type" error — an old sidecar; stop
         #: attempting delta frames until reconnect
         self._delta_unsupported = False
@@ -428,8 +464,15 @@ class ComputePlaneClient:
         return self._sock
 
     def _roundtrip(self, mtype: int, payload: bytes) -> Tuple[int, bytes]:
+        from volcano_tpu import faults
+
+        fp = faults.get_plane()
         with self._lock:
             try:
+                if fp.enabled and mtype != T_PING and fp.should("compute.timeout"):
+                    # the timeout failure mode without waiting the full
+                    # timeout out: same exception type, same recovery
+                    raise socket.timeout("fault-injected compute-plane timeout")
                 sock = self._connect()
                 _send_frame(sock, mtype, payload)
                 return _recv_frame(sock)
@@ -444,20 +487,31 @@ class ComputePlaneClient:
         except Exception:  # noqa: BLE001
             return False
 
+    def _ack(self, gen: int, key: str, rev: int) -> None:
+        """Record the server-held revision — only while the connection
+        generation the round trip ran under is still current (a close()
+        in between means the peer that acked is gone)."""
+        with self._state_lock:
+            if self._session_gen == gen:
+                self._acked[key] = rev
+
     def allocate(self, snap, explain: bool = False) -> np.ndarray:
         key = getattr(snap, "cache_key", None)
         self.last_reason_counts = None
+        with self._state_lock:
+            gen = self._session_gen
+            acked = self._acked.get(key) if key else None
         if (
             key
             and snap.delta is not None
             and not self._delta_unsupported
-            and self._acked.get(key) == snap.delta.base_rev
+            and acked == snap.delta.base_rev
         ):
             mtype, payload = self._roundtrip(
                 T_ALLOC_DELTA_REQ, serialize_delta(snap, explain=explain)
             )
             if mtype == T_ALLOC_RESP:
-                self._acked[key] = snap.rev
+                self._ack(gen, key, snap.rev)
                 _, arrays = _unpack_arrays(payload)
                 self.last_reason_counts = arrays.get("reason_counts")
                 return arrays["assignment"]
@@ -475,7 +529,7 @@ class ComputePlaneClient:
         if mtype == T_ERROR:
             raise RuntimeError(f"compute plane: {payload.decode()}")
         if key:
-            self._acked[key] = snap.rev
+            self._ack(gen, key, snap.rev)
         _, arrays = _unpack_arrays(payload)
         self.last_reason_counts = arrays.get("reason_counts")
         return arrays["assignment"]
@@ -496,3 +550,15 @@ class ComputePlaneClient:
                 # the next connection may reach a restarted (upgraded)
                 # sidecar — re-probe delta support
                 self._delta_unsupported = False
+        # Session-loss recovery: a closed connection means the next peer
+        # may be a RESTARTED sidecar holding no session store.  Forget
+        # every acked revision so the re-handshake ships a full frame
+        # (which re-seeds the server's delta base) instead of trusting
+        # state that died with the old process.  T_NEED_FULL would
+        # eventually correct a stale ack too, but only after a wasted
+        # delta round trip per session key.  The generation bump makes
+        # the clear stick: a watchdog-abandoned allocate() completing
+        # after this close cannot re-insert its (now dead) ack.
+        with self._state_lock:
+            self._session_gen += 1
+            self._acked.clear()

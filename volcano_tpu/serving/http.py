@@ -60,7 +60,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return
-            body = b"ok"
+            # degraded ≠ unhealthy: the daemon is alive and scheduling,
+            # but a fallback rung is carrying the load (open circuit
+            # breaker, unreachable compute-plane sidecar).  200 so
+            # liveness probes don't restart a working pod; the body
+            # names the reason so operators and the chaos harness see
+            # the demotion.
+            degraded = getattr(self.server, "degraded_source", None)
+            reason = degraded() if degraded is not None else None
+            body = f"degraded: {reason}".encode() if reason else b"ok"
             ctype = "text/plain"
         elif self.path == "/metrics":
             body = self.server.registry.render().encode()
@@ -156,6 +164,15 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
 
+def _default_degraded() -> Optional[str]:
+    """Default /healthz degraded source: every open circuit breaker in
+    the process (executor demotions, unreachable compute-plane)."""
+    from volcano_tpu.faults.breaker import degraded_reasons
+
+    reasons = degraded_reasons()
+    return "; ".join(reasons) if reasons else None
+
+
 def debug_allowed(debug_enabled: bool, client_ip: str) -> bool:
     """/debug/stacks policy: loopback always, anything else only with
     the explicit opt-in."""
@@ -175,6 +192,7 @@ class ServingServer:
         debug_enabled: bool = False,
         recorder=None,
         explain_source=None,
+        degraded_source=None,
     ):
         self._host = host
         self._port = port
@@ -190,6 +208,14 @@ class ServingServer:
         #: optional (namespace, job) -> dict|None backing /explain —
         #: the scheduler daemon wires serving/explain.explain_jobs here
         self._explain_source = explain_source
+        #: optional () -> Optional[str]; a non-empty reason turns
+        #: /healthz's 200 body into "degraded: <reason>".  None = the
+        #: process-global breaker registry (volcano_tpu.faults.breaker)
+        self._degraded_source = (
+            degraded_source
+            if degraded_source is not None
+            else _default_degraded
+        )
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -205,6 +231,7 @@ class ServingServer:
         self._httpd.debug_enabled = self._debug_enabled
         self._httpd.recorder = self._recorder
         self._httpd.explain_source = self._explain_source
+        self._httpd.degraded_source = self._degraded_source
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="vtpu-serving", daemon=True
         )
