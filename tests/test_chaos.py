@@ -113,9 +113,11 @@ class ChaosCluster:
         self.api.watch("Pod", self._audit, send_initial=False)
 
         self.client = SchedulerClient(self.remote)
-        #: ns/name → successful bind_pod calls — a second successful
-        #: bind for one pod is a duplicate even if it picked the same
-        #: node (the k8s binding subresource would 409)
+        #: ns/name → successful bind calls — a second successful bind
+        #: for one pod is a duplicate even if it picked the same node
+        #: (the k8s binding subresource would 409).  Both bind paths are
+        #: counted: per-object bind_pod AND binds riding the coalesced
+        #: commit_batch frame (the pipelined plane's fast path).
         self.bind_calls = defaultdict(int)
         original_bind = self.client.bind_pod
 
@@ -124,9 +126,29 @@ class ChaosCluster:
             self.bind_calls[f"{namespace}/{name}"] += 1
 
         self.client.bind_pod = counted_bind
+        original_commit = self.client.commit_batch
 
+        def counted_commit(binds=(), evicts=(), events=(), conditions=(),
+                           pod_groups=()):
+            binds = list(binds)
+            results = original_commit(
+                binds=binds, evicts=evicts, events=events,
+                conditions=conditions, pod_groups=pod_groups,
+            )
+            for b, err in zip(binds, results.get("binds", ())):
+                if err is None:
+                    self.bind_calls[f"{b['namespace']}/{b['name']}"] += 1
+            return results
+
+        self.client.commit_batch = counted_commit
+
+        # the chaos loop runs with the PIPELINED commit plane on —
+        # faults fire while commits are in flight, and the acceptance
+        # bar (no dup binds, no lost jobs, coherence, bit-identical
+        # pinned map vs the fault-free twin) must hold regardless
         self.cache = SchedulerCache(
-            client=self.client, scheduler_name="volcano-tpu"
+            client=self.client, scheduler_name="volcano-tpu",
+            pipelined_commit=True,
         )
         # chaos-rate timing: resync retries and quarantine re-entry
         # collapse from seconds to cycle-scale
@@ -256,6 +278,7 @@ class ChaosCluster:
         from volcano_tpu.ops import executor
 
         executor.configure(None)
+        self.cache.stop_commit_plane()
         if self.cp is not None:
             self.cp.stop()
         self.remote.close()
@@ -278,7 +301,9 @@ MIXED_FAULTS = (
     "compute.timeout=0.08:count=2;"
     "device.lowering=0.1:count=2;"
     "cache.bind_fail=0.12:count=5;"
-    "cache.resync_fail=0.3:count=3"
+    "cache.resync_fail=0.3:count=3;"
+    "commit.fail=0.15:count=4;"
+    "commit.delay=0.2:count=6:ms=30"
 )
 
 

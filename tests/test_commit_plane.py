@@ -1,0 +1,468 @@
+"""Pipelined commit plane equivalence suite (ISSUE 6).
+
+The contract: with ``SchedulerCache(pipelined_commit=True)`` the commit
+path (binder round trips, Scheduled/Evict/Unschedulable audit events,
+pod conditions, PodGroup status writebacks) runs on background bind
+workers coalesced into batched commit frames — and the RESULTING STORE
+STATE is byte-identical to the synchronous path's, over both the
+in-process backend and the real TCP bus, with a commit barrier at the
+next snapshot keeping cache/store coherence.  "Byte-identical" is
+modulo the fields that differ between ANY two runs (resourceVersions,
+timestamps, the per-session condition transition_id): every
+user-visible byte — node assignments, phases, condition
+type/status/reason/message, Event type/reason/message/count, PodGroup
+phase/counters — must match.
+
+Also covered: multi-bind coalescing (one frame per cycle, not one per
+pod), the VBUS v2 / old-peer per-object fallback, a mid-cycle apiserver
+restart while the commit queue is non-empty, and the commit.fail /
+commit.delay fault points.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from collections import defaultdict
+
+import pytest
+
+from volcano_tpu import faults
+from volcano_tpu.bus import protocol
+from volcano_tpu.bus.remote import RemoteAPIServer
+from volcano_tpu.bus.server import BusServer
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.client import (
+    ADDED,
+    APIServer,
+    KubeClient,
+    MODIFIED,
+    SchedulerClient,
+    VolcanoClient,
+)
+from volcano_tpu.client.apiserver import ApiError
+from volcano_tpu.scheduler.scheduler import Scheduler
+
+from tests.builders import build_node, build_pod, build_pod_group, build_queue
+
+CONF_JAX = """
+actions: "enqueue, jax-allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+#: the host allocate action drives the Statement loop — covers the
+#: batched Statement.commit path the kernel's fast-apply bypasses
+CONF_HOST = CONF_JAX.replace("jax-allocate", "allocate")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.configure(None)
+    yield
+    faults.configure(None)
+
+
+def _wait(pred, timeout=20.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class MiniCluster:
+    """One scheduler control loop over a seeded store — in-process or
+    through the real TCP bus — with a store-truth rebind audit."""
+
+    def __init__(self, tmp_path, name, backend="inproc", pipelined=False,
+                 conf=CONF_JAX):
+        self.api = APIServer()
+        self.kube = KubeClient(self.api)
+        self.vc = VolcanoClient(self.api)
+        self.vc.create_queue(build_queue("default"))
+        for i in range(4):
+            self.kube.create_node(
+                build_node(f"n{i}", {"cpu": "4", "memory": "16Gi"})
+            )
+        self.bus = self.remote = None
+        if backend == "bus":
+            self.bus = BusServer(self.api).start()
+            self.remote = RemoteAPIServer(
+                f"tcp://127.0.0.1:{self.bus.port}", timeout=5.0
+            )
+            assert self.remote.wait_ready(10.0)
+            client_api = self.remote
+        else:
+            client_api = self.api
+        self.bound = {}
+        self.rebinds = []
+        self.api.watch("Pod", self._audit, send_initial=False)
+        self.client = SchedulerClient(client_api)
+        self.cache = SchedulerCache(
+            client=self.client, scheduler_name="volcano-tpu",
+            pipelined_commit=pipelined,
+        )
+        conf_path = tmp_path / f"{name}-conf.yaml"
+        conf_path.write_text(conf)
+        self.scheduler = Scheduler(self.cache, scheduler_conf_path=str(conf_path))
+        self.cache.run()
+
+    def _audit(self, event, old, new):
+        if event not in (ADDED, MODIFIED) or new is None:
+            return
+        key = f"{new.metadata.namespace}/{new.metadata.name}"
+        node = new.spec.node_name
+        if not node:
+            return
+        prev = self.bound.get(key)
+        if prev is None:
+            self.bound[key] = node
+        elif prev != node:
+            self.rebinds.append((key, prev, node))
+
+    def submit_workload(self):
+        """Three gang jobs + one provably-unschedulable job, so the run
+        exercises binds, Scheduled events, and the full Unschedulable
+        writeback (events + conditions + PodGroup condition)."""
+        for jname, replicas, cpu in (
+            ("g0", 3, "1"), ("g1", 2, "1"), ("big", 1, "100"),
+        ):
+            self.vc.create_pod_group(build_pod_group("ns", jname, replicas))
+            for i in range(replicas):
+                self.kube.create_pod(build_pod(
+                    "ns", f"{jname}-t{i}", "", {"cpu": cpu, "memory": "1Gi"},
+                    group=jname,
+                ))
+
+    def wait_synced(self, n_tasks):
+        assert _wait(lambda: sum(
+            len(j.tasks) for j in self.cache.jobs.values()
+        ) >= n_tasks), "cache never saw the workload"
+
+    def cycle(self):
+        self.scheduler.run_once()
+
+    def placed(self):
+        return {
+            f"{p.metadata.namespace}/{p.metadata.name}": p.spec.node_name
+            for p in self.kube.list_pods("ns") if p.spec.node_name
+        }
+
+    def close(self):
+        self.cache.stop_commit_plane()
+        if self.remote is not None:
+            self.remote.close()
+        if self.bus is not None:
+            self.bus.stop()
+
+
+def store_digest(api, counts=True):
+    """Every user-visible byte of the commit path's output — excludes
+    only resourceVersions, timestamps, and the per-session
+    transition_id, which differ between any two runs."""
+    pods = {}
+    for p in api.list("Pod"):
+        pods[f"{p.metadata.namespace}/{p.metadata.name}"] = (
+            p.spec.node_name,
+            p.status.phase,
+            tuple(sorted(
+                (c.type, c.status, c.reason, c.message)
+                for c in p.status.conditions
+            )),
+        )
+    events = {}
+    for e in api.list("Event"):
+        key = (e.involved_object.get("name"), e.type, e.reason)
+        events[key] = (e.count if counts else None, e.message)
+    pgs = {}
+    for g in api.list("PodGroup"):
+        pgs[f"{g.metadata.namespace}/{g.metadata.name}"] = (
+            g.status.phase, g.status.running, g.status.succeeded,
+            g.status.failed,
+            tuple(sorted(
+                (c.type, c.status, c.reason, c.message)
+                for c in g.status.conditions
+            )),
+        )
+    return {"pods": pods, "events": events, "pod_groups": pgs}
+
+
+@pytest.mark.parametrize("conf", [CONF_JAX, CONF_HOST],
+                         ids=["jax-allocate", "host-allocate"])
+def test_pipelined_matches_sync_inproc(tmp_path, conf):
+    """In-process backend: fully deterministic, so the digests —
+    including Event COUNTS — must be equal byte for byte."""
+    digests = []
+    for mode, pipelined in (("sync", False), ("pipe", True)):
+        cluster = MiniCluster(tmp_path, f"{mode}-{conf[:20].strip()}",
+                              pipelined=pipelined, conf=conf)
+        try:
+            cluster.submit_workload()
+            cluster.wait_synced(6)
+            for _ in range(3):
+                cluster.cycle()
+            cluster.cache.flush()
+            assert cluster.rebinds == []
+            digests.append(store_digest(cluster.api))
+        finally:
+            cluster.close()
+    assert digests[0] == digests[1]
+    # the workload actually exercised every commit section
+    assert sum(1 for v in digests[0]["pods"].values() if v[0]) == 5
+    assert ("big-t0", "Warning", "Unschedulable") in digests[0]["events"]
+    assert any(c and c[0][0] == "PodScheduled"
+               for _n, _p, c in digests[0]["pods"].values())
+
+
+def test_pipelined_matches_sync_over_bus(tmp_path):
+    """The same equivalence through the real TCP bus (coalesced VBUS
+    commit_batch frames).  Watch echoes propagate asynchronously over
+    the wire, so Event counts (which depend on how many cycles re-saw
+    stale state) are excluded; everything else must match."""
+    digests = []
+    for mode, pipelined in (("sync", False), ("pipe", True)):
+        cluster = MiniCluster(tmp_path, f"bus-{mode}", backend="bus",
+                              pipelined=pipelined)
+        try:
+            cluster.submit_workload()
+            cluster.wait_synced(6)
+            assert _wait(
+                lambda: (cluster.cycle() or True) and len(cluster.placed()) == 5,
+                timeout=30.0, interval=0.05,
+            )
+            cluster.cache.flush()
+            # settle: the Unschedulable writeback for "big" must land
+            assert _wait(lambda: any(
+                e.reason == "Unschedulable" for e in cluster.api.list("Event", "ns")
+            ))
+            assert cluster.rebinds == []
+            digests.append(store_digest(cluster.api, counts=False))
+        finally:
+            cluster.close()
+    assert digests[0] == digests[1]
+
+
+def test_cycle_binds_coalesce_into_one_frame(tmp_path):
+    """5 binds in a cycle must travel as ONE commit_batch frame, not 5
+    round trips — the multi-bind coalescing claim, measured at the
+    client boundary."""
+    cluster = MiniCluster(tmp_path, "coalesce", pipelined=True)
+    frames = []
+    orig = cluster.client.commit_batch
+
+    def counting(binds=(), evicts=(), events=(), conditions=(), pod_groups=()):
+        frames.append({
+            "binds": len(list(binds)), "evicts": len(list(evicts)),
+            "events": len(list(events)), "conditions": len(list(conditions)),
+            "pod_groups": len(list(pod_groups)),
+        })
+        return orig(binds=binds, evicts=evicts, events=events,
+                    conditions=conditions, pod_groups=pod_groups)
+
+    cluster.client.commit_batch = counting
+    try:
+        cluster.submit_workload()
+        cluster.wait_synced(6)
+        cluster.cycle()
+        cluster.cache.flush()
+        assert max(f["binds"] for f in frames) == 5, frames
+        # the per-job status writebacks coalesced too (g0+g1+big → one
+        # or two frames, never one per pod)
+        status_frames = [f for f in frames if f["pod_groups"]]
+        assert status_frames and len(status_frames) <= 2, frames
+        from volcano_tpu.metrics.metrics import registry
+
+        hist = registry._histograms.get(("volcano_bind_coalesce_size", ()))
+        assert hist is not None and hist.total >= 5
+    finally:
+        cluster.close()
+
+
+def test_commit_barrier_at_next_snapshot(tmp_path):
+    """commit.delay keeps the queue observably non-empty after the
+    action returns; the next snapshot's barrier must drain it before
+    new state is read."""
+    cluster = MiniCluster(tmp_path, "barrier", pipelined=True)
+    try:
+        cluster.submit_workload()
+        cluster.wait_synced(6)
+        faults.configure("seed=3;commit.delay=1:ms=150")
+        cluster.cycle()
+        plane = cluster.cache._commit_plane
+        cluster.cache.snapshot()  # the barrier
+        faults.configure(None)
+        assert plane.depth == 0
+        assert len(cluster.placed()) == 5  # landed BEFORE the snapshot
+        assert plane.last_barrier["busy_ms"] > 0
+    finally:
+        cluster.close()
+
+
+def test_commit_fail_takes_resync_path_no_duplicates(tmp_path):
+    """Doomed commit items (commit.fail) route to the FailedScheduling +
+    resync path; the loop converges with zero duplicate binds."""
+    cluster = MiniCluster(tmp_path, "fail", pipelined=True)
+    try:
+        cluster.submit_workload()
+        cluster.wait_synced(6)
+        faults.configure("seed=9;commit.fail=1:count=3")
+        cluster.cycle()
+        faults.configure(None)
+        assert _wait(
+            lambda: (cluster.cycle() or True) and len(cluster.placed()) == 5,
+            timeout=30.0, interval=0.05,
+        )
+        cluster.cache.flush()
+        assert cluster.rebinds == []
+        failed = [
+            e for e in cluster.api.list("Event", "ns")
+            if e.reason == "FailedScheduling"
+            and "fault-injected commit failure" in e.message
+        ]
+        assert failed, "doomed items left no FailedScheduling audit trail"
+    finally:
+        cluster.close()
+
+
+def test_midcycle_apiserver_restart_with_nonempty_queue(tmp_path):
+    """Kill the apiserver while the commit queue holds binds in flight;
+    the restarted incarnation (same store, new epoch) must end with
+    every pod bound exactly once."""
+    cluster = MiniCluster(tmp_path, "restart", backend="bus", pipelined=True)
+    try:
+        cluster.submit_workload()
+        cluster.wait_synced(6)
+        faults.configure("seed=5;commit.delay=1:ms=400")
+        cluster.cycle()
+        plane = cluster.cache._commit_plane
+        assert plane.depth > 0, "commit queue drained before the kill"
+        port = cluster.bus.port
+        cluster.bus.stop()
+        faults.configure(None)
+        cluster.bus = BusServer(cluster.api, port=port).start()
+        # barrier + resync at the next snapshots; the loop must converge
+        assert _wait(
+            lambda: (cluster.cycle() or True) and len(cluster.placed()) == 5,
+            timeout=45.0, interval=0.05,
+        ), "control loop did not converge after apiserver restart"
+        cluster.cache.flush()
+        assert cluster.rebinds == []
+        assert plane.depth == 0
+    finally:
+        cluster.close()
+
+
+def test_evict_through_commit_plane(tmp_path):
+    """Evictions ride the plane too: pod deleted at the store, the
+    Evict audit event recorded, identical to the synchronous path."""
+    results = []
+    for pipelined in (False, True):
+        api = APIServer()
+        kube, vc = KubeClient(api), VolcanoClient(api)
+        vc.create_queue(build_queue("default"))
+        kube.create_node(build_node("n0", {"cpu": "4", "memory": "16Gi"}))
+        vc.create_pod_group(build_pod_group("ns", "v0", 1))
+        kube.create_pod(build_pod(
+            "ns", "v0-t0", "n0", {"cpu": "1", "memory": "1Gi"},
+            phase="Running", group="v0",
+        ))
+        cache = SchedulerCache(client=SchedulerClient(api),
+                               pipelined_commit=pipelined)
+        cache.run()
+        job = next(iter(cache.jobs.values()))
+        task = next(iter(job.tasks.values()))
+        cache.evict(task, "preempt")
+        cache.flush()
+        results.append((
+            kube.get_pod("ns", "v0-t0") is None,
+            [(e.reason, e.message) for e in api.list("Event", "ns")],
+        ))
+        cache.stop_commit_plane()
+    assert results[0] == results[1]
+    assert results[0][0] is True
+    assert ("Evict", "Evicted ns/v0-t0: preempt") in results[0][1]
+
+
+def test_old_peer_fallback_per_object_binds(tmp_path):
+    """A v1 server that rejects the commit_batch op degrades the client
+    to per-object binds — permanently flagged, still correct."""
+
+    class V1BusServer(BusServer):
+        def _execute(self, conn, req_id, payload, op):
+            if op == "commit_batch":
+                raise ApiError(f"unknown bus op {op!r}")
+            return super()._execute(conn, req_id, payload, op)
+
+    api = APIServer()
+    kube = KubeClient(api)
+    kube.create_pod(build_pod("ns", "p0", "", {"cpu": "1", "memory": "1Gi"}))
+    bus = V1BusServer(api).start()
+    remote = RemoteAPIServer(f"tcp://127.0.0.1:{bus.port}", timeout=5.0)
+    try:
+        assert remote.wait_ready(10.0)
+        results = remote.commit_batch(binds=[{
+            "namespace": "ns", "name": "p0", "hostname": "n0",
+            "event": {"type": "Normal", "reason": "Scheduled",
+                      "message": "Successfully assigned ns/p0 to n0"},
+        }])
+        assert results["binds"] == [None]
+        assert remote._no_commit_batch is True
+        assert kube.get_pod("ns", "p0").spec.node_name == "n0"
+        assert any(e.reason == "Scheduled" for e in api.list("Event", "ns"))
+        # the fallback sticks — no second rejected frame
+        results = remote.commit_batch(binds=[{
+            "namespace": "ns", "name": "p0", "hostname": "n0",
+        }])
+        assert results["binds"] == [None]
+    finally:
+        remote.close()
+        bus.stop()
+
+
+def test_v1_frames_still_decode():
+    """The VBUS version bump keeps v1 frames decodable (MIN_VERSION),
+    so a skewed peer's frames are not rejected at the framing layer."""
+    a, b = socket.socketpair()
+    try:
+        body = b'{"op":"get"}'
+        a.sendall(protocol._HEADER.pack(
+            protocol.MAGIC, 1, protocol.T_REQ, 7, len(body)) + body)
+        mtype, corr_id, payload = protocol.recv_frame(b)
+        assert (mtype, corr_id, payload) == (protocol.T_REQ, 7, {"op": "get"})
+        a.sendall(protocol._HEADER.pack(
+            protocol.MAGIC, protocol.VERSION + 1, protocol.T_REQ, 7, 0))
+        with pytest.raises(ValueError):
+            protocol.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_overlap_metrics_exported(tmp_path):
+    """The satellite metrics: queue depth gauge, coalesce histogram,
+    overlap ratio — all present after a pipelined run."""
+    from volcano_tpu.metrics.metrics import registry
+
+    cluster = MiniCluster(tmp_path, "metrics", pipelined=True)
+    try:
+        cluster.submit_workload()
+        cluster.wait_synced(6)
+        cluster.cycle()
+        cluster.cache.snapshot()
+        rendered = registry.render()
+        assert "volcano_commit_queue_depth 0" in rendered
+        assert "volcano_bind_coalesce_size_count" in rendered
+        assert "volcano_commit_overlap_ratio" in rendered
+    finally:
+        cluster.close()

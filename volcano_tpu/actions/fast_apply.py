@@ -372,7 +372,19 @@ def try_fast_apply(
         # anti-affinity sets: gate guarantees no pod (anti-)affinity terms
         # (needs_host_validation would be set), so nothing to maintain.
 
+    import time
+
+    t0 = time.perf_counter()
     cache.bind_batch([(t, t.node_name) for t in bulk])
+    # what the scheduling thread actually paid for the commit: with the
+    # pipelined plane this is the mutex-held state mutation plus the
+    # queue handoff — the binder/bus round trips land on the bind
+    # workers, overlapped with the next cycle
+    from volcano_tpu.actions import jax_allocate as _ja
+
+    _ja.last_phase_stats["commit_handoff_ms"] = (
+        time.perf_counter() - t0
+    ) * 1e3
     # journal only after the batch landed — "bind" means an actual
     # cache bind, and bind_batch mutates nothing when it raises
     if ssn._trace.enabled:

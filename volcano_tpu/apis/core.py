@@ -73,7 +73,15 @@ class K8sObject:
         return serde.from_dict(cls, data)
 
     def clone(self):
-        return serde.from_dict(type(self), serde.to_dict(self, drop_empty=False))
+        # deepcopy, NOT a to_dict/from_dict round trip: the store clones
+        # on every get/update/notify, and the serde walk's typing
+        # dispatch made each clone ~10x a structural copy — at 50k-pod
+        # commit batches the round trip WAS the relay floor.  Objects
+        # built from the wire still normalize through from_dict; a clone
+        # of a well-formed object is structurally identical either way.
+        import copy
+
+        return copy.deepcopy(self)
 
 
 @dataclass

@@ -8,19 +8,40 @@ from __future__ import annotations
 
 import copy
 import dataclasses
+import functools
 import re
 import typing
 
 _CAMEL_RE = re.compile(r"(?<!^)(?=[A-Z])")
 
 
+@functools.lru_cache(maxsize=4096)
 def snake(name: str) -> str:
     return _CAMEL_RE.sub("_", name).lower()
 
 
+@functools.lru_cache(maxsize=4096)
 def camel(name: str) -> str:
     head, *tail = name.split("_")
     return head + "".join(p.capitalize() for p in tail)
+
+
+#: cls → (resolved type hints, field-name set).  ``get_type_hints``
+#: re-compiles every PEP-563 string annotation on every call — at one
+#: call per from_dict it dominated the whole store (every clone(),
+#: every bus frame, every commit) with ~0.8 ms of typing machinery per
+#: object; the hints are immutable per class, so resolve once.
+_CLASS_INFO: dict = {}
+
+
+def _class_info(cls):
+    cached = _CLASS_INFO.get(cls)
+    if cached is None:
+        hints = typing.get_type_hints(cls)
+        names = frozenset(f.name for f in dataclasses.fields(cls))
+        cached = (hints, names)
+        _CLASS_INFO[cls] = cached
+    return cached
 
 
 def _unwrap_optional(tp):
@@ -53,8 +74,7 @@ def from_dict(cls, data):
         return None
     if dataclasses.is_dataclass(data.__class__):
         return copy.deepcopy(data)
-    hints = typing.get_type_hints(cls)
-    names = {f.name for f in dataclasses.fields(cls)}
+    hints, names = _class_info(cls)
     kwargs = {}
     for key, value in data.items():
         name = key if key in names else snake(key)
@@ -74,12 +94,29 @@ def _to_value(value, drop_empty: bool):
     return copy.deepcopy(value)
 
 
+#: cls → ((field name, camelCase name), ...) — ``dataclasses.fields``
+#: plus the camel conversion per call showed up on the bus fan-out
+#: profile (every watch notify encodes old+new); both are immutable
+#: per class.
+_FIELD_NAMES: dict = {}
+
+
+def _field_names(cls):
+    cached = _FIELD_NAMES.get(cls)
+    if cached is None:
+        cached = tuple(
+            (f.name, camel(f.name)) for f in dataclasses.fields(cls)
+        )
+        _FIELD_NAMES[cls] = cached
+    return cached
+
+
 def to_dict(obj, drop_empty: bool = True) -> dict:
     """Dataclass → dict with camelCase keys; empty/None fields dropped."""
     out = {}
-    for f in dataclasses.fields(obj):
-        value = getattr(obj, f.name)
+    for name, camel_name in _field_names(obj.__class__):
+        value = getattr(obj, name)
         if drop_empty and (value is None or value == [] or value == {}):
             continue
-        out[camel(f.name)] = _to_value(value, drop_empty)
+        out[camel_name] = _to_value(value, drop_empty)
     return out

@@ -42,7 +42,18 @@ from volcano_tpu.client.apiserver import (
 )
 
 MAGIC = b"VBUS"
-VERSION = 1
+#: v2 adds the coalesced ``commit_batch`` request op (one frame carrying
+#: N binds + evictions + audit events + status writebacks, applied as a
+#: single store transaction).  The frame LAYOUT is unchanged, so frames
+#: are STAMPED with MIN_VERSION — a v1 peer accepts every frame at the
+#: framing layer, and a v2 client talking to a v1 server detects the
+#: unknown ``commit_batch`` op from the typed error and falls back to
+#: per-object binds (bus/remote.py).  VERSION is the protocol revision
+#: this build speaks; receivers accept [MIN_VERSION, VERSION].
+VERSION = 2
+#: oldest frame version this build still decodes — and the version
+#: outgoing frames carry, since the layout has not changed since v1
+MIN_VERSION = 1
 
 T_REQ = 1            # client → server: one store operation
 T_RESP = 2           # server → client: success payload for a T_REQ
@@ -137,7 +148,10 @@ def parse_bus_url(url: str) -> Tuple[str, int]:
 
 def send_frame(sock: socket.socket, mtype: int, corr_id: int, payload: dict) -> None:
     body = json.dumps(payload, separators=(",", ":")).encode()
-    sock.sendall(_HEADER.pack(MAGIC, VERSION, mtype, corr_id, len(body)) + body)
+    # stamped MIN_VERSION: the layout is v1's, so version-skewed peers
+    # never reject at the framing layer — capability skew surfaces as an
+    # op-level typed error instead (the commit_batch fallback path)
+    sock.sendall(_HEADER.pack(MAGIC, MIN_VERSION, mtype, corr_id, len(body)) + body)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -155,7 +169,7 @@ def recv_frame(sock: socket.socket) -> Tuple[int, int, dict]:
     magic, version, mtype, corr_id, length = _HEADER.unpack(head)
     if magic != MAGIC:
         raise ValueError("bad magic")
-    if version != VERSION:
+    if not (MIN_VERSION <= version <= VERSION):
         raise ValueError(f"unsupported bus protocol version {version}")
     payload = json.loads(_recv_exact(sock, length).decode()) if length else {}
     return mtype, corr_id, payload

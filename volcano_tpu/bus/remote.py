@@ -126,6 +126,10 @@ class RemoteAPIServer:
         #: (kind, operation) → [hook]; replayed to the server on connect
         self._admission: Dict[Tuple[str, str], List] = {}
 
+        #: set once a server rejects the v2 ``commit_batch`` op — the
+        #: old-peer fallback (per-object binds) for skewed apiservers
+        self._no_commit_batch = False
+
         self._ctl: "queue.Queue[tuple]" = queue.Queue()
         self._dispatch_q: "queue.Queue[Optional[tuple]]" = queue.Queue()
         self._admit_q: "queue.Queue[Optional[tuple]]" = queue.Queue()
@@ -410,6 +414,44 @@ class RemoteAPIServer:
         resp = self._call({"op": "delete", "kind": kind,
                            "namespace": namespace, "name": name})
         return protocol.decode_obj(resp["object"])
+
+    def commit_batch(self, binds=(), evicts=(), events=(), conditions=(),
+                     pod_groups=()):
+        """Coalesced commit frame (protocol v2): one VBUS request
+        carrying N binds + evictions + audit events + status writebacks,
+        applied server-side as a single store transaction.  A v1 server
+        answers ``unknown bus op`` — the client then degrades PERMANENTLY
+        (per connection lifetime) to per-object binds through the shared
+        :func:`client.apiserver.apply_commit_batch` semantics, so a
+        version-skewed apiserver costs throughput, never correctness."""
+        if not self._no_commit_batch:
+            try:
+                resp = self._call({
+                    "op": "commit_batch",
+                    "binds": list(binds),
+                    "evicts": list(evicts),
+                    "events": list(events),
+                    "conditions": list(conditions),
+                    "pod_groups": [protocol.encode_obj(pg)
+                                   for pg in pod_groups],
+                })
+                return resp["results"]
+            except BusError:
+                raise  # transport failure — NOT a capability signal
+            except ApiError as e:
+                if "unknown bus op" not in str(e):
+                    raise
+                log.warning(
+                    "bus %s does not speak commit_batch (old peer); "
+                    "falling back to per-object binds", self.address,
+                )
+                self._no_commit_batch = True
+        from volcano_tpu.client.apiserver import apply_commit_batch
+
+        return apply_commit_batch(
+            self, binds=binds, evicts=evicts, events=events,
+            conditions=conditions, pod_groups=pod_groups,
+        )
 
     def record_event(
         self,

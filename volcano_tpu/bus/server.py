@@ -427,6 +427,23 @@ class BusServer:
         if op == "delete":
             old = api.delete(payload["kind"], payload["namespace"], payload["name"])
             return {"object": protocol.encode_obj(old)}
+        if op == "commit_batch":
+            # the coalesced bind/commit frame (protocol v2): N binds +
+            # evictions + audit events + status writebacks applied as
+            # ONE store transaction with one watch-notification flush —
+            # the per-object sections skip admission exactly like the
+            # update_status subresource path they are built from
+            results = api.commit_batch(
+                binds=payload.get("binds", ()),
+                evicts=payload.get("evicts", ()),
+                events=payload.get("events", ()),
+                conditions=payload.get("conditions", ()),
+                pod_groups=[
+                    protocol.decode_obj(d)
+                    for d in payload.get("pod_groups", ())
+                ],
+            )
+            return {"results": results}
         if op == "watch":
             self._handle_watch(conn, req_id, payload)
             return None  # responses pushed inline for ordering

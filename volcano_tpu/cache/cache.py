@@ -157,6 +157,8 @@ class SchedulerCache(Cache):
         sync_side_effects: bool = True,
         client=None,
         snapshot_reuse: bool = False,
+        pipelined_commit: bool = False,
+        commit_workers: int = 2,
     ):
         self._mutex = threading.RLock()
         self.scheduler_name = scheduler_name
@@ -252,6 +254,40 @@ class SchedulerCache(Cache):
         self._pool: Optional[ThreadPoolExecutor] = None
         self._pending: List[Future] = []
 
+        # ---- pipelined commit plane (cache/commit_plane.py) ----
+        # Opt-in: bind/evict/status effects are queued and drained by a
+        # pool of bind workers, coalesced into batched commit frames,
+        # with a commit barrier at the next snapshot().  Off by default:
+        # the synchronous effects stay the deterministic baseline every
+        # equivalence test pins the pipelined plane against.
+        self._commit_plane = None
+        if pipelined_commit:
+            from volcano_tpu.cache.commit_plane import CommitPlane
+
+            self._commit_plane = CommitPlane(self, workers=commit_workers)
+        # Fast-path eligibility for the coalesced commit frame: only the
+        # DEFAULT binder/evictor/status-updater wired to THIS cache's
+        # client are known to be equivalent to the frame's server-side
+        # application; custom implementations (tests, recorders) keep
+        # the per-object calls so they observe every effect.
+        _cb = getattr(self.client, "commit_batch", None) if self.client \
+            else None
+        self._fast_bind = (
+            _cb is not None
+            and isinstance(self.binder, DefaultBinder)
+            and self.binder.client is self.client
+        )
+        self._fast_evict = (
+            _cb is not None
+            and isinstance(self.evictor, DefaultEvictor)
+            and self.evictor.client is self.client
+        )
+        self._fast_status = (
+            _cb is not None
+            and isinstance(self.status_updater, DefaultStatusUpdater)
+            and self.status_updater.client is self.client
+        )
+
     # ---- lifecycle ----
 
     def run(self) -> None:
@@ -265,9 +301,24 @@ class SchedulerCache(Cache):
 
     def flush(self) -> None:
         """Wait for async side effects (test/shutdown aid)."""
+        if self._commit_plane is not None:
+            self._commit_plane.barrier()
         for f in list(self._pending):
             f.result()
         self._pending.clear()
+
+    def stop_commit_plane(self) -> None:
+        """Drain and stop the pipelined commit workers (shutdown aid)."""
+        if self._commit_plane is not None:
+            self._commit_plane.stop()
+
+    def enable_pipelined_commit(self, workers: int = 2) -> None:
+        """Turn the pipelined commit plane on post-construction (bench /
+        embedding aid — daemons pass ``pipelined_commit=True``)."""
+        if self._commit_plane is None:
+            from volcano_tpu.cache.commit_plane import CommitPlane
+
+            self._commit_plane = CommitPlane(self, workers=workers)
 
     def _run_effect(self, fn, *args) -> None:
         if self._sync or self._pool is None:
@@ -562,6 +613,14 @@ class SchedulerCache(Cache):
     # ---- snapshot (cache.go:712-790) ----
 
     def snapshot(self) -> ClusterInfo:
+        # COMMIT BARRIER: every in-flight pipelined effect (binds,
+        # evicts, status writebacks handed off last cycle) must land
+        # before new cluster state is read — this is what keeps the
+        # overlapped commit plane coherent with the store and the replay
+        # journal bit-identical to the synchronous path.  Failed items
+        # enqueued their resyncs, which the drain below then retries.
+        if self._commit_plane is not None:
+            self._commit_plane.barrier()
         # backed-off resync entries retry on the cycle boundary — the
         # natural drain point, and the snapshot then reflects whatever
         # truth the retries recovered
@@ -697,27 +756,7 @@ class SchedulerCache(Cache):
             self._mark_job(task.job)
             self._mark_node(hostname)
 
-        def effect():
-            try:
-                self._maybe_fail_bind()
-                if self.binder is not None:
-                    self.binder.bind(task, hostname)
-            except Exception as e:  # noqa: BLE001
-                log.error("bind of %s/%s failed: %s", task.namespace, task.name, e)
-                self._record_event(
-                    task, "Warning", "FailedScheduling",
-                    f"failed to bind to {hostname}: {e}",
-                )
-                self.resync_task(task)
-            else:
-                # cache.go:600-610 — the Scheduled audit event
-                self._record_event(
-                    task, "Normal", "Scheduled",
-                    f"Successfully assigned {task.namespace}/{task.name}"
-                    f" to {hostname}",
-                )
-
-        self._run_effect(effect)
+        self._dispatch_binds([(task, hostname)])
 
     @staticmethod
     def _maybe_fail_bind() -> None:
@@ -761,29 +800,156 @@ class SchedulerCache(Cache):
                 self._mark_node(hostname)
                 bound.append((task, hostname))
 
-        def effect():
-            for task, hostname in bound:
-                try:
-                    self._maybe_fail_bind()
-                    if self.binder is not None:
-                        self.binder.bind(task, hostname)
-                except Exception as e:  # noqa: BLE001
-                    log.error(
-                        "bind of %s/%s failed: %s", task.namespace, task.name, e
-                    )
-                    self._record_event(
-                        task, "Warning", "FailedScheduling",
-                        f"failed to bind to {hostname}: {e}",
-                    )
-                    self.resync_task(task)
-                else:
-                    self._record_event(
-                        task, "Normal", "Scheduled",
-                        f"Successfully assigned {task.namespace}/{task.name}"
-                        f" to {hostname}",
-                    )
+        self._dispatch_binds(bound)
 
-        self._run_effect(effect)
+    # ---- commit dispatch: pipelined plane or synchronous effects ----
+
+    def _dispatch_binds(self, pairs) -> None:
+        if not pairs:
+            return
+        if self._commit_plane is not None:
+            self._commit_plane.submit_binds(pairs)
+        else:
+            self._run_effect(
+                self._run_bind_items, [(t, h, None) for t, h in pairs]
+            )
+
+    def _dispatch_evicts(self, pairs) -> None:
+        if not pairs:
+            return
+        if self._commit_plane is not None:
+            self._commit_plane.submit_evicts(pairs)
+        else:
+            self._run_effect(
+                self._run_evict_items, [(t, r, None) for t, r in pairs]
+            )
+
+    def _run_bind_items(self, items, inject: bool = True) -> None:
+        """Land ``[(task, hostname, doom)]`` binder effects: one
+        coalesced commit frame when the default binder is wired to a
+        commit_batch-capable client (in-process APIServer or the VBUS
+        v2 remote), per-object binder calls otherwise.  ``doom`` is a
+        pre-drawn injected failure (the commit plane evaluates fault
+        points at submit time); ``inject`` draws cache.bind_fail here —
+        the synchronous path, where this IS the submitting thread.
+        Failures, injected or real, take the same FailedScheduling-event
+        + resync path the synchronous effects always have."""
+        ok = []
+        for task, hostname, doom in items:
+            try:
+                if doom is not None:
+                    raise doom
+                if inject:
+                    self._maybe_fail_bind()
+            except Exception as e:  # noqa: BLE001
+                self._fail_bind_item(task, hostname, e)
+                continue
+            ok.append((task, hostname))
+        if not ok:
+            return
+        from volcano_tpu.metrics import metrics
+
+        metrics.observe_bind_coalesce(len(ok))
+        if self._fast_bind:
+            frame = [
+                {
+                    "namespace": t.namespace, "name": t.name, "hostname": h,
+                    "event": {
+                        "type": "Normal", "reason": "Scheduled",
+                        "message": f"Successfully assigned"
+                                   f" {t.namespace}/{t.name} to {h}",
+                    },
+                }
+                for t, h in ok
+            ]
+            try:
+                results = self.client.commit_batch(binds=frame)["binds"]
+            except Exception as e:  # noqa: BLE001 — frame-level failure
+                # (bus down mid-flight): every item takes the resync path
+                for t, h in ok:
+                    self._fail_bind_item(t, h, e)
+                return
+            for (t, h), err in zip(ok, results):
+                if err is not None:
+                    self._fail_bind_item(t, h, RuntimeError(err))
+            return
+        for task, hostname in ok:
+            try:
+                if self.binder is not None:
+                    self.binder.bind(task, hostname)
+            except Exception as e:  # noqa: BLE001
+                self._fail_bind_item(task, hostname, e)
+            else:
+                # cache.go:600-610 — the Scheduled audit event
+                self._record_event(
+                    task, "Normal", "Scheduled",
+                    f"Successfully assigned {task.namespace}/{task.name}"
+                    f" to {hostname}",
+                )
+
+    def _fail_bind_item(self, task, hostname, e) -> None:
+        from volcano_tpu.metrics import metrics
+
+        log.error("bind of %s/%s failed: %s", task.namespace, task.name, e)
+        metrics.register_commit_failure("bind")
+        self._record_event(
+            task, "Warning", "FailedScheduling",
+            f"failed to bind to {hostname}: {e}",
+        )
+        self.resync_task(task)
+
+    def _run_evict_items(self, items) -> None:
+        """Land ``[(task, reason, doomed)]`` evictor effects — same
+        fast/slow split and failure semantics as the bind items."""
+        ok = []
+        for task, reason, doom in items:
+            if doom is not None:
+                self._fail_evict_item(task, doom)
+                continue
+            ok.append((task, reason))
+        if not ok:
+            return
+        if self._fast_evict:
+            frame = [
+                {
+                    "namespace": t.namespace, "name": t.name,
+                    "event": {
+                        "type": "Normal", "reason": "Evict",
+                        "message": f"Evicted {t.namespace}/{t.name}: {r}",
+                    },
+                }
+                for t, r in ok
+            ]
+            try:
+                results = self.client.commit_batch(evicts=frame)["evicts"]
+            except Exception as e:  # noqa: BLE001
+                for t, _r in ok:
+                    self._fail_evict_item(t, e)
+                return
+            for (t, _r), err in zip(ok, results):
+                if err is not None:
+                    self._fail_evict_item(t, RuntimeError(err))
+            return
+        for task, reason in ok:
+            try:
+                if self.evictor is not None:
+                    self.evictor.evict(task)
+            except Exception as e:  # noqa: BLE001
+                self._fail_evict_item(task, e)
+            else:
+                # cache.go:528 — the Evict audit event (reason carries
+                # the action: "preempt" / "reclaim")
+                self._record_event(
+                    task, "Normal", "Evict",
+                    f"Evicted {task.namespace}/{task.name}: {reason}",
+                )
+
+    def _fail_evict_item(self, task, e) -> None:
+        from volcano_tpu.metrics import metrics
+
+        log.error("evict of %s/%s failed: %s", task.namespace, task.name, e)
+        metrics.register_commit_failure("evict")
+        self.resync_task(task)
 
     def _record_event(self, task: TaskInfo, type_: str, reason: str, message: str) -> None:
         """Record a pod-scoped Event through the bus (the user-facing
@@ -825,22 +991,7 @@ class SchedulerCache(Cache):
             self._mark_job(task.job)
             self._mark_node(task.node_name)
 
-        def effect():
-            try:
-                if self.evictor is not None:
-                    self.evictor.evict(task)
-            except Exception as e:  # noqa: BLE001
-                log.error("evict of %s/%s failed: %s", task.namespace, task.name, e)
-                self.resync_task(task)
-            else:
-                # cache.go:528 — the Evict audit event (reason carries the
-                # action: "preempt" / "reclaim")
-                self._record_event(
-                    task, "Normal", "Evict",
-                    f"Evicted {task.namespace}/{task.name}: {reason}",
-                )
-
-        self._run_effect(effect)
+        self._dispatch_evicts([(task, reason)])
 
     # ---- volume binding (cache.go:243-258, 617-623) ----
 
@@ -1080,3 +1231,128 @@ class SchedulerCache(Cache):
         if self.status_updater is None or job.pod_group is None:
             return job.pod_group
         return self.status_updater.update_pod_group(job.pod_group)
+
+    def update_job_status_async(self, job: JobInfo) -> Optional[scheduling.PodGroup]:
+        """Pipelined per-job status writeback: capture the whole
+        writeback — Unschedulable events + PodScheduled conditions for
+        pending tasks, plus the PodGroup status update — as ONE
+        commit-plane item, so a 50k-pod cycle's close issues O(jobs)
+        coalesced frames instead of O(pods) bus round trips.  Falls back
+        to the synchronous :meth:`update_job_status` when the plane is
+        off.  The /explain digest is parked synchronously (it is
+        host-side state the next request may read); the bus writes land
+        before the next snapshot's commit barrier."""
+        if self._commit_plane is None:
+            return self.update_job_status(job)
+        payload = {"events": [], "conditions": [], "pod_group": None}
+        if self.status_updater is not None:
+            # same capture as record_job_status_event, deferred delivery
+            base_message = job.job_fit_errors
+            tasks_digest: Dict[str, dict] = {}
+            for task in job.tasks.values():
+                if task.status != TaskStatus.Pending:
+                    continue
+                fit_errors = job.nodes_fit_errors.get(task.uid)
+                message = (
+                    fit_errors.error() if fit_errors is not None
+                    else base_message
+                )
+                if message:
+                    tasks_digest[task.uid] = {
+                        "name": task.name,
+                        "message": message,
+                    }
+                payload["events"].append(
+                    (task, "Warning", "Unschedulable", message)
+                )
+                payload["conditions"].append(
+                    (task, "Unschedulable", message)
+                )
+            with self._mutex:
+                if tasks_digest:
+                    self.unschedulable_digest[job.uid] = {
+                        "namespace": job.namespace,
+                        "name": job.name,
+                        "queue": job.queue,
+                        "job_fit_errors": job.job_fit_errors,
+                        "tasks": tasks_digest,
+                    }
+                else:
+                    self.unschedulable_digest.pop(job.uid, None)
+            if job.pod_group is not None:
+                payload["pod_group"] = job.pod_group
+        if payload["events"] or payload["conditions"] or payload["pod_group"]:
+            self._commit_plane.submit_status(payload)
+        return job.pod_group
+
+    def _run_status_items(self, items) -> None:
+        """Land ``[(payload, doomed)]`` status-writeback items.  Fast
+        path: the whole batch of jobs becomes one commit frame (events +
+        conditions + PodGroup statuses).  Slow path: the per-object
+        calls the synchronous writeback makes.  Failures are logged and
+        counted — the next cycle's updater recomputes and retries, the
+        same convergence a synchronous writeback error relies on."""
+        from volcano_tpu.metrics import metrics
+
+        live = []
+        for payload, doom in items:
+            if doom is not None:
+                metrics.register_commit_failure("status")
+                log.error("status writeback dropped by injected fault; "
+                          "next cycle retries")
+                continue
+            live.append(payload)
+        if not live:
+            return
+        if self._fast_status:
+            events = [
+                {
+                    "namespace": t.namespace,
+                    "involved": {"kind": "Pod", "namespace": t.namespace,
+                                 "name": t.name},
+                    "type": type_, "reason": reason, "message": message,
+                }
+                for p in live
+                for t, type_, reason, message in p["events"]
+            ]
+            conditions = [
+                {"namespace": t.namespace, "name": t.name,
+                 "reason": reason, "message": message}
+                for p in live
+                for t, reason, message in p["conditions"]
+            ]
+            pod_groups = [p["pod_group"] for p in live
+                          if p["pod_group"] is not None]
+            try:
+                results = self.client.commit_batch(
+                    events=events, conditions=conditions,
+                    pod_groups=pod_groups,
+                )
+            except Exception as e:  # noqa: BLE001
+                metrics.register_commit_failure("status")
+                log.error("batched status writeback failed: %s", e)
+                return
+            for section in ("events", "conditions", "pod_groups"):
+                for err in results.get(section, ()):
+                    if err is not None:
+                        metrics.register_commit_failure("status")
+                        log.error("status writeback %s failed: %s",
+                                  section, err)
+            return
+        for p in live:
+            for t, type_, reason, message in p["events"]:
+                self._record_event(t, type_, reason, message)
+            for t, reason, message in p["conditions"]:
+                try:
+                    self.status_updater.update_pod_condition(
+                        t, reason, message
+                    )
+                except Exception as e:  # noqa: BLE001
+                    metrics.register_commit_failure("status")
+                    log.error("update pod condition failed: %s", e)
+            if p["pod_group"] is not None and self.status_updater is not None:
+                try:
+                    self.status_updater.update_pod_group(p["pod_group"])
+                except Exception as e:  # noqa: BLE001
+                    metrics.register_commit_failure("status")
+                    log.error("update pod group failed: %s", e)

@@ -230,6 +230,58 @@ class APIServer:
             obj = self._store.get(kind, {}).get(f"{namespace}/{name}")
             return obj.clone() if obj is not None else None
 
+    # ---- coalesced commit transaction (the multi-bind frame) ----
+
+    def commit_batch(
+        self,
+        binds=(),
+        evicts=(),
+        events=(),
+        conditions=(),
+        pod_groups=(),
+    ) -> Dict[str, List[Optional[str]]]:
+        """Apply one coalesced commit frame — N pod bindings, evictions,
+        audit events, pod conditions, and PodGroup status writebacks —
+        under ONE store lock hold, so the whole scheduler cycle's commit
+        is a single store transaction with one watch-notification flush
+        instead of O(pods) independent round trips.
+
+        Sections (plain dicts; ``pod_groups`` are API objects):
+
+        * ``binds``: ``{namespace, name, hostname, event?}`` — the
+          binding subresource write (get + node_name + update_status,
+          exactly ``KubeClient.bind_pod``); on success the optional
+          ``event`` (``{type, reason, message}``) is recorded — the
+          per-object path's success-gated Scheduled audit event.
+        * ``evicts``: ``{namespace, name, event?}`` — pod delete with
+          the same success-gated Evict event.
+        * ``events``: ``{namespace, involved, type, reason, message}``
+          — standalone audit events (Unschedulable writebacks), run
+          through the same aggregation correlator as record_event.
+        * ``conditions``: ``{namespace, name, reason, message}`` — the
+          PodScheduled=False condition write.
+        * ``pod_groups``: PodGroup objects for status writeback, with
+          the raw-v1alpha1 fallback ``SchedulerClient.update_pod_group``
+          applies.
+
+        Per-item failures are COLLECTED, not raised: the return maps
+        each section to a list of ``None`` (success) or an error string
+        aligned with the input order, so the caller can route failed
+        binds/evicts to the resync path exactly like the per-object
+        effects do.  Like ``update_status``, the binding/status writes
+        skip admission (status subresources); event creates run the
+        in-process admission chain via the normal ``create`` path.
+
+        The per-item application lives in :func:`apply_commit_batch`,
+        which works against ANY APIServer surface — the remote client's
+        old-server fallback runs the same items per-object over the
+        wire."""
+        with self._lock:
+            return apply_commit_batch(
+                self, binds=binds, evicts=evicts, events=events,
+                conditions=conditions, pod_groups=pod_groups,
+            )
+
     def list(self, kind: str, namespace: Optional[str] = None) -> List:
         with self._lock:
             out = []
@@ -288,3 +340,102 @@ class APIServer:
             for dkind, dobj in deleted:
                 self._notify(dkind, DELETED, dobj.clone(), None)
             return old
+
+def apply_commit_batch(
+    api,
+    binds=(),
+    evicts=(),
+    events=(),
+    conditions=(),
+    pod_groups=(),
+) -> Dict[str, List[Optional[str]]]:
+    """Apply the commit-frame sections through ``api``'s public surface
+    — delegating to the SAME typed-client helpers the per-object
+    effects use (``KubeClient.bind_pod`` / ``update_pod_condition``,
+    the event correlator, ``SchedulerClient.update_pod_group``'s
+    v1alpha1 fallback), so batched and per-object semantics cannot
+    drift.  One copy shared by the in-process store transaction (which
+    wraps this in its lock) and the bus client's per-object old-server
+    fallback."""
+    from volcano_tpu.apis import scheme
+    from volcano_tpu.client.clients import KubeClient, record_event_via
+
+    kube = KubeClient(api)
+
+    results: Dict[str, List[Optional[str]]] = {
+        "binds": [], "evicts": [], "events": [],
+        "conditions": [], "pod_groups": [],
+    }
+
+    def _err(e: Exception) -> str:
+        return f"{type(e).__name__}: {e}"
+
+    def _commit_event(namespace: str, name: str, event) -> None:
+        # success-gated audit event for a bind/evict item — best-effort,
+        # like the per-object _record_event discipline
+        if not event:
+            return
+        try:
+            record_event_via(
+                api, namespace,
+                {"kind": "Pod", "namespace": namespace, "name": name},
+                event["type"], event["reason"], event["message"],
+            )
+        except ApiError:
+            pass
+
+    for b in binds:
+        try:
+            kube.bind_pod(b["namespace"], b["name"], b["hostname"])
+            results["binds"].append(None)
+        except ApiError as e:
+            results["binds"].append(_err(e))
+            continue
+        _commit_event(b["namespace"], b["name"], b.get("event"))
+    for ev in evicts:
+        try:
+            api.delete("Pod", ev["namespace"], ev["name"])
+            results["evicts"].append(None)
+        except ApiError as e:
+            results["evicts"].append(_err(e))
+            continue
+        _commit_event(ev["namespace"], ev["name"], ev.get("event"))
+    for e in events:
+        try:
+            record_event_via(
+                api, e["namespace"], e["involved"], e["type"],
+                e["reason"], e["message"],
+            )
+            results["events"].append(None)
+        except ApiError as exc:
+            results["events"].append(_err(exc))
+    for c in conditions:
+        try:
+            # silently no-ops when the pod is gone, like the per-object
+            # update_pod_condition
+            kube.update_pod_condition(
+                c["namespace"], c["name"], c["reason"], c["message"]
+            )
+            results["conditions"].append(None)
+        except ApiError as e:
+            results["conditions"].append(_err(e))
+    for pg in pod_groups:
+        try:
+            api.update_status(pg)
+            results["pod_groups"].append(None)
+        except NotFoundError:
+            # raw-v1alpha1 residents (the dual informer set) get status
+            # written to THAT kind, like SchedulerClient.update_pod_group
+            # — including its missing-from-both silent no-op (a job
+            # deleted mid-cycle must not read as a commit failure)
+            try:
+                api.update_status(scheme.pod_group_hub_to_v1alpha1(pg))
+                results["pod_groups"].append(None)
+            except NotFoundError:
+                results["pod_groups"].append(None)
+            except ApiError as e:
+                results["pod_groups"].append(_err(e))
+        except ApiError as e:
+            results["pod_groups"].append(_err(e))
+    return results
+

@@ -322,6 +322,19 @@ class SchedulerClient:
     def update_pvc(self, pvc: core.PersistentVolumeClaim) -> core.PersistentVolumeClaim:
         return self.kube.update_pvc(pvc)
 
+    def commit_batch(self, binds=(), evicts=(), events=(), conditions=(),
+                     pod_groups=()):
+        """Coalesced commit frame — one store transaction for N binds /
+        evicts / events / conditions / PodGroup writebacks (the commit
+        plane's fast path).  Works against both backends: the in-process
+        APIServer applies it under one lock hold, the RemoteAPIServer
+        ships it as one VBUS frame (with a per-object fallback for
+        old servers)."""
+        return self.api.commit_batch(
+            binds=binds, evicts=evicts, events=events,
+            conditions=conditions, pod_groups=pod_groups,
+        )
+
     def record_event(
         self,
         namespace: str,

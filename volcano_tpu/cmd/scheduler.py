@@ -40,6 +40,7 @@ class SchedulerDaemon(BaseDaemon):
         gc_quiesce_period: int = 0,
         snapshot_reuse: bool = False,
         cycle_deadline_ms=None,
+        pipelined_commit: bool = False,
         **daemon_kw,
     ):
         # /explain reads self.cache lazily (set right below) — the
@@ -53,6 +54,7 @@ class SchedulerDaemon(BaseDaemon):
             client=SchedulerClient(api),
             scheduler_name=scheduler_name,
             snapshot_reuse=snapshot_reuse,
+            pipelined_commit=pipelined_commit,
         )
         self.scheduler = Scheduler(
             self.cache, scheduler_conf_path=scheduler_conf,
@@ -119,6 +121,14 @@ def main(argv=None) -> int:
         help="reuse the previous session's untouched clones at session "
         "open (warm-cycle optimization; relies on the shipped actions' "
         "touched-set discipline — leave off with out-of-tree actions)",
+    )
+    parser.add_argument(
+        "--pipelined-commit", action="store_true",
+        help="overlap the commit path (binds, evictions, status "
+        "writebacks) with the next cycle's pack+device phase: effects "
+        "queue onto bind workers, coalesce into batched commit frames, "
+        "and a commit barrier at the next snapshot preserves coherence "
+        "and replay bit-identity",
     )
     parser.add_argument(
         "--warmup", action="store_true",
@@ -191,6 +201,7 @@ def main(argv=None) -> int:
             gc_quiesce_period=args.gc_quiesce_period,
             snapshot_reuse=args.snapshot_reuse,
             cycle_deadline_ms=args.cycle_deadline_ms or None,
+            pipelined_commit=args.pipelined_commit,
             listen_host=args.listen_host,
             listen_port=args.listen_port,
             leader_elect=args.leader_elect,

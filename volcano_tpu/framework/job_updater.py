@@ -72,7 +72,18 @@ class JobUpdater:
             attempt = "unschedulable"
         try:
             if is_pod_group_status_updated(old_status, job.pod_group.status):
-                ssn.cache.update_job_status(job)
+                # pipelined caches capture the whole per-job writeback
+                # (events + conditions + PodGroup status) as one
+                # commit-plane item — a 50k-pod close issues O(jobs)
+                # coalesced frames, not O(pods) round trips.  Other
+                # caches keep the synchronous write.
+                updater = getattr(
+                    self.ssn.cache, "update_job_status_async", None
+                )
+                if updater is not None:
+                    updater(job)
+                else:
+                    self.ssn.cache.update_job_status(job)
         except Exception as e:  # noqa: BLE001 — next session retries
             attempt = "error"
             log.error("Failed to update job status <%s/%s>: %s", job.namespace, job.name, e)
@@ -84,6 +95,15 @@ class JobUpdater:
             return
         if len(self.job_queue) == 1:
             self._update_job(self.job_queue[0])
+            return
+        # With a pipelined commit plane the per-job capture is cheap
+        # host work and the bus writes land on the bind workers — fan
+        # out and the pool threads would only contend on the plane's
+        # queue.  The synchronous writeback keeps the reference's
+        # 16-goroutine fan-out (job_updater.go) for its I/O overlap.
+        if getattr(self.ssn.cache, "_commit_plane", None) is not None:
+            for job in self.job_queue:
+                self._update_job(job)
             return
         with ThreadPoolExecutor(max_workers=_WORKERS) as pool:
             list(pool.map(self._update_job, self.job_queue))

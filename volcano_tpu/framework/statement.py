@@ -104,7 +104,12 @@ class Statement:
         self.ssn._fire_allocate(task)
         self.operations.append(("allocate", (task, hostname)))
 
-    def _commit_allocate(self, task: TaskInfo, hostname: str) -> None:
+    def _stage_allocate(self, task: TaskInfo, hostname: str,
+                        pending: list) -> None:
+        """Queue an allocate's cache bind for the next coalesced flush.
+        The volume bind stays per-task and synchronous — its failure
+        unwinds THIS task only (statement.go:263-270), before anything
+        was staged for it."""
         try:
             self.ssn.cache.bind_volumes(task)
         except Exception as e:  # noqa: BLE001 — statement.go:263-270: a
@@ -116,12 +121,29 @@ class Statement:
             self._unallocate(task)
             self.ssn.cache.resync_task(task)
             return
-        self.ssn.cache.bind(task, task.node_name)
-        if self.ssn._trace.enabled:
-            self.ssn._trace.decision("bind", task.uid, task.node_name)
-        job = self.ssn.jobs.get(task.job)
-        if job is not None:
-            job.update_task_status(task, TaskStatus.Binding)
+        pending.append(task)
+
+    def _flush_binds(self, pending: list) -> None:
+        """Land the staged allocates through ONE cache.bind_batch — the
+        same per-task mutations in the same order under one mutex hold,
+        with the binder effects coalesced into one commit-frame instead
+        of per-object round trips.  Caches without bind_batch get the
+        per-task calls."""
+        if not pending:
+            return
+        cache = self.ssn.cache
+        if hasattr(cache, "bind_batch"):
+            cache.bind_batch([(t, t.node_name) for t in pending])
+        else:
+            for t in pending:
+                cache.bind(t, t.node_name)
+        for task in pending:
+            if self.ssn._trace.enabled:
+                self.ssn._trace.decision("bind", task.uid, task.node_name)
+            job = self.ssn.jobs.get(task.job)
+            if job is not None:
+                job.update_task_status(task, TaskStatus.Binding)
+        pending.clear()
 
     def _unallocate(self, task: TaskInfo) -> None:
         job = self.ssn.jobs.get(task.job)
@@ -145,13 +167,19 @@ class Statement:
         self.operations.clear()
 
     def commit(self) -> None:
+        # consecutive allocates coalesce into one bind_batch (one mutex
+        # hold, one commit frame); an interleaved evict flushes first so
+        # cache-side effect ordering matches the operation log
+        pending: List[TaskInfo] = []
         for name, args in self.operations:
             if name == "evict":
+                self._flush_binds(pending)
                 self._commit_evict(*args)
             elif name == "allocate":
-                self._commit_allocate(*args)
+                self._stage_allocate(args[0], args[1], pending)
             # pipeline has no cache-side commit (statement.go:158-159),
             # but a committed pipeline IS a decision — journal it
             elif name == "pipeline" and self.ssn._trace.enabled:
                 self.ssn._trace.decision("pipeline", args[0].uid, args[1])
+        self._flush_binds(pending)
         self.operations.clear()

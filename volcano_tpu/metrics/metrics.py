@@ -331,6 +331,44 @@ def update_resync_quarantined(count: int) -> None:
     registry.set_gauge(f"{_NAMESPACE}_resync_quarantined_tasks", {}, count)
 
 
+# ---- pipelined commit plane (cache/commit_plane.py) ----
+
+#: coalesce sizes are small powers of two up to the per-frame cap
+_COALESCE_BUCKETS = [1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                     4096, 8192]
+
+
+def update_commit_queue_depth(depth: int) -> None:
+    """volcano_commit_queue_depth: commit-plane items (binds / evicts /
+    status writebacks) enqueued but not yet landed on the bus."""
+    registry.set_gauge(f"{_NAMESPACE}_commit_queue_depth", {}, depth)
+
+
+def observe_bind_coalesce(size: int) -> None:
+    """volcano_bind_coalesce_size: how many binds one coalesced
+    commit-plane frame carried — the multi-bind batching win is visible
+    as mass in the high buckets."""
+    registry.histogram(
+        f"{_NAMESPACE}_bind_coalesce_size", {}, buckets=_COALESCE_BUCKETS
+    ).observe(size)
+
+
+def update_commit_overlap_ratio(ratio: float) -> None:
+    """volcano_commit_overlap_ratio: per commit-barrier, the fraction of
+    the plane's busy time that overlapped other host work instead of
+    blocking the barrier — 1.0 means the whole commit landed behind the
+    next cycle's pack+device phase, 0.0 means the barrier absorbed all
+    of it (no better than synchronous)."""
+    registry.set_gauge(f"{_NAMESPACE}_commit_overlap_ratio", {}, ratio)
+
+
+def register_commit_failure(kind: str) -> None:
+    """volcano_commit_failures_total{kind}: commit-plane items whose
+    async effect failed (kind ∈ {bind, evict, status}); binds/evicts
+    take the resync path, status writebacks retry next cycle."""
+    registry.inc(f"{_NAMESPACE}_commit_failures_total", {"kind": kind})
+
+
 # ---- TPU-build additions: per-kernel phase timings ----
 
 def update_kernel_duration(phase: str, seconds: float) -> None:
