@@ -3,9 +3,28 @@ sharding paths are exercised without TPU hardware.
 
 The axon TPU plugin (when present) registers itself via sitecustomize and
 overrides JAX_PLATFORMS, so the env var alone is not enough — the config
-update after import is what actually pins the CPU backend."""
+update after import is what actually pins the CPU backend.
 
+Two suite-wide guards live here too:
+
+* **Thread-leak guard** — every test asserts it left no new
+  *non-daemon* threads behind (a small named allowlist excepted).  An
+  abandoned bind worker or watchdog thread fails the test that leaked
+  it, loudly and with the thread names, instead of wedging the exit of
+  some unrelated later test.
+* **Lock-order verifier** (opt-in, ``VTPU_LOCK_ORDER=1``) — wraps every
+  lock volcano_tpu creates in the instrumented proxy from
+  ``volcano_tpu.analysis.lock_order``, records the cross-thread
+  acquisition graph, fails the leaking test on any ABBA inversion, and
+  fails the session if the final graph has a cycle.  CI runs the chaos
+  and commit-plane suites under it; ``VTPU_LOCK_ORDER_REPORT=<path>``
+  additionally dumps the acquisition graph as JSON.
+"""
+
+import json
 import os
+import threading
+import time
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -14,6 +33,96 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# must precede any volcano_tpu import so every lock construction runs
+# through the patched factories
+_LOCK_ORDER = os.environ.get("VTPU_LOCK_ORDER") == "1"
+if _LOCK_ORDER:
+    from volcano_tpu.analysis import lock_order
+
+    lock_order.install()
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+# ---- thread-leak guard ----
+
+#: non-daemon threads these names (prefixes) are allowed to outlive a
+#: test: pytest/session infrastructure only.  Project threads are all
+#: daemon=True by convention — anything non-daemon left running is a
+#: shutdown bug (the exact class this guard exists for: abandoned
+#: watchdog / bind-worker threads used to wedge interpreter exit).
+_LEAK_ALLOWLIST = (
+    "MainThread",
+    "pytest_timeout",      # pytest-timeout watcher, when installed
+    "ThreadPoolExecutor",  # joined at interpreter exit by concurrent.futures
+)
+_LEAK_GRACE_S = 2.0
+
+
+def _leaked_nondaemon(before):
+    return [
+        t for t in threading.enumerate()
+        if t not in before
+        and t.is_alive()
+        and not t.daemon
+        and not t.name.startswith(_LEAK_ALLOWLIST)
+    ]
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_guard():
+    before = set(threading.enumerate())
+    yield
+    leaked = _leaked_nondaemon(before)
+    if leaked:
+        # teardown finalizers may still be joining — give them a moment
+        deadline = time.monotonic() + _LEAK_GRACE_S
+        while leaked and time.monotonic() < deadline:
+            time.sleep(0.05)
+            leaked = _leaked_nondaemon(before)
+    assert not leaked, (
+        "test leaked non-daemon thread(s): "
+        + ", ".join(sorted(t.name for t in leaked))
+        + " — stop/join them in the test (or daemonize them if they are "
+        "genuinely fire-and-forget)"
+    )
+
+
+# ---- lock-order verifier wiring ----
+
+if _LOCK_ORDER:
+
+    @pytest.fixture(autouse=True)
+    def _lock_order_guard():
+        """Fail the test that CLOSED a lock-order cycle — per-test
+        attribution beats one opaque session-end failure."""
+        n_before = len(lock_order.violations())
+        yield
+        fresh = lock_order.violations()[n_before:]
+        assert not fresh, (
+            "lock-order inversion(s) recorded during this test:\n"
+            + "\n".join(v.render() for v in fresh)
+        )
+
+    def pytest_sessionfinish(session, exitstatus):
+        report = lock_order.report()
+        path = os.environ.get("VTPU_LOCK_ORDER_REPORT")
+        if path:
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(report, f, indent=2)
+                f.write("\n")
+        if report["violations"]:
+            session.exitstatus = 3
+
+    def pytest_terminal_summary(terminalreporter):
+        report = lock_order.report()
+        terminalreporter.write_line(
+            f"lock-order verifier: {report['locks']} instrumented locks, "
+            f"{len(report['edges'])} acquisition edges, "
+            f"{len(report['violations'])} violation(s)"
+        )
+        for v in report["violations"]:
+            terminalreporter.write_line(v)

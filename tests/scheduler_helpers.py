@@ -8,13 +8,13 @@ tiers, run the real action, assert on the binds the fake binder received.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import List
 
 import volcano_tpu.actions  # noqa: F401 — registers actions
 import volcano_tpu.plugins  # noqa: F401 — registers plugin builders
 from volcano_tpu.cache import SchedulerCache
 from volcano_tpu.conf import PluginOption, Tier
-from volcano_tpu.framework import open_session, close_session
+from volcano_tpu.framework import close_session, open_session
 
 from tests.fakes import FakeBinder, FakeEvictor, FakeStatusUpdater
 

@@ -11,7 +11,7 @@ from volcano_tpu.admission import mutate_job, register_webhooks, validate_job
 from volcano_tpu.admission.pods import validate_pod
 from volcano_tpu.apis import batch, core, scheduling
 from volcano_tpu.cli import main as vtctl
-from volcano_tpu.client import AdmissionError, APIServer, KubeClient, VolcanoClient
+from volcano_tpu.client import AdmissionError, APIServer, VolcanoClient
 
 
 def base_job(**spec_kw):

@@ -1,0 +1,488 @@
+"""Static-analysis suite coverage: every pass gets positive (finding
+expected) and negative (clean) fixture snippets, the baseline
+suppression machinery round-trips, the serde-drift pass catches
+registry drift, and the runtime lock-order verifier detects a contrived
+ABBA interleave while staying quiet on consistent ordering.
+
+The last class pins the whole-tree contract the CI `analysis` job
+enforces: `python -m volcano_tpu.analysis` over this repo exits 0 —
+which also pins every genuine violation this PR fixed (unlocked
+guarded-attribute accesses in trace/recorder, bus/remote,
+client/apiserver, serving/compute_plane, cache/cache; the serde
+round-trip registry) against regression: reverting any fix re-raises
+its finding and fails the suite.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+
+import pytest
+
+from volcano_tpu.analysis import determinism, jit_safety, lock_discipline
+from volcano_tpu.analysis import lock_order, serde_drift
+from volcano_tpu.analysis.__main__ import find_root, main as analysis_main
+from volcano_tpu.analysis.core import Baseline, Finding, SourceFile
+
+
+def _src(text: str, rel: str = "volcano_tpu/fixture.py") -> SourceFile:
+    return SourceFile("<fixture>", rel, text)
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+# ---- lock discipline ----
+
+
+class TestLockDiscipline:
+    def test_unlocked_write_and_read_flagged(self):
+        findings = lock_discipline.check_file(_src(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = []  # guarded-by: self._lock\n"
+            "    def bad_write(self):\n"
+            "        self._items.append(1)\n"
+            "    def bad_read(self):\n"
+            "        return len(self._items)\n"
+            "    def good(self):\n"
+            "        with self._lock:\n"
+            "            self._items.clear()\n"
+        ))
+        assert _codes(findings) == ["LCK001", "LCK001"]
+        assert {f.symbol for f in findings} == {
+            "C.bad_write:_items", "C.bad_read:_items",
+        }
+
+    def test_locked_access_and_init_are_clean(self):
+        findings = lock_discipline.check_file(_src(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = []  # guarded-by: self._lock\n"
+            "        self._items.append(0)\n"  # construction is exempt
+            "    def good(self):\n"
+            "        with self._lock:\n"
+            "            self._items.append(1)\n"
+            "            return list(self._items)\n"
+        ))
+        assert findings == []
+
+    def test_requires_lock_helper_trusted(self):
+        findings = lock_discipline.check_file(_src(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0  # guarded-by: self._lock\n"
+            "    def _bump(self):\n"
+            "        # requires-lock: self._lock\n"
+            "        self._n += 1\n"
+            "    def caller(self):\n"
+            "        with self._lock:\n"
+            "            self._bump()\n"
+        ))
+        assert findings == []
+
+    def test_closure_resets_held_scope(self):
+        # the with-scope does NOT extend into a nested def: the closure
+        # runs later, when the lock has long been released
+        findings = lock_discipline.check_file(_src(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0  # guarded-by: self._lock\n"
+            "    def make(self):\n"
+            "        with self._lock:\n"
+            "            def cb():\n"
+            "                return self._n\n"
+            "            return cb\n"
+        ))
+        assert _codes(findings) == ["LCK001"]
+        assert findings[0].symbol == "C.make.cb:_n"
+
+    def test_unlocked_ok_waiver(self):
+        findings = lock_discipline.check_file(_src(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._flag = False  # guarded-by: self._lock\n"
+            "    def peek(self):\n"
+            "        return self._flag  # unlocked-ok: benign flag read\n"
+            "    def set(self):\n"
+            "        with self._lock:\n"
+            "            self._flag = True\n"
+        ))
+        assert findings == []
+
+    def test_module_global_guard_and_global_stmt_write(self):
+        findings = lock_discipline.check_file(_src(
+            "import threading\n"
+            "_lock = threading.Lock()\n"
+            "_state = {}  # guarded-by: _lock\n"
+            "def bad_write(v):\n"
+            "    global _state\n"
+            "    _state = v\n"
+            "def good(v):\n"
+            "    with _lock:\n"
+            "        _state[1] = v\n"
+            "def shadow():\n"
+            "    _state = {}\n"  # local binding — not the global
+            "    return _state\n"
+        ))
+        assert _codes(findings) == ["LCK001"]
+        assert findings[0].symbol == "bad_write:_state"
+
+    def test_stale_annotation_dead_lock_flagged(self):
+        findings = lock_discipline.check_file(_src(
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._n = 0  # guarded-by: self._never_taken\n"
+        ))
+        assert _codes(findings) == ["LCK002"]
+
+
+# ---- determinism ----
+
+
+class TestDeterminism:
+    def test_wall_clock_and_global_rng_flagged(self):
+        findings = determinism.check_file(_src(
+            "import random\n"
+            "import time\n"
+            "def decide():\n"
+            "    if random.random() < 0.5:\n"
+            "        return time.time()\n"
+        ))
+        assert sorted(_codes(findings)) == ["DET001", "DET002"]
+
+    def test_seeded_rng_and_monotonic_are_clean(self):
+        findings = determinism.check_file(_src(
+            "import random\n"
+            "import time\n"
+            "import numpy as np\n"
+            "def decide(seed):\n"
+            "    rng = random.Random(seed)\n"
+            "    st = np.random.RandomState(seed)\n"
+            "    t0 = time.monotonic()\n"
+            "    return rng.random() + st.rand() + time.perf_counter() - t0\n"
+        ))
+        assert findings == []
+
+    def test_set_iteration_order_escape(self):
+        findings = determinism.check_file(_src(
+            "def leak(xs):\n"
+            "    out = []\n"
+            "    for x in set(xs):\n"
+            "        out.append(x)\n"
+            "    return out + list({1, 2})\n"
+        ))
+        assert _codes(findings) == ["DET003", "DET003"]
+
+    def test_sorted_set_is_the_blessed_fix(self):
+        findings = determinism.check_file(_src(
+            "def ok(xs):\n"
+            "    return [x for x in sorted(set(xs))]\n"
+        ))
+        assert findings == []
+
+    def test_det_marker_waives(self):
+        findings = determinism.check_file(_src(
+            "import time\n"
+            "def stamp():\n"
+            "    return time.time()  # det: journal timestamp\n"
+        ))
+        assert findings == []
+
+    def test_uuid_entropy_flagged(self):
+        findings = determinism.check_file(_src(
+            "import uuid\n"
+            "def ident():\n"
+            "    return uuid.uuid4().hex\n"
+        ))
+        assert _codes(findings) == ["DET004"]
+
+
+# ---- jit safety ----
+
+
+class TestJitSafety:
+    def test_item_and_concretize_inside_jit(self):
+        findings = jit_safety.check_file(_src(
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    v = x.sum().item()\n"
+            "    return float(x[0]) + v\n"
+        ))
+        assert sorted(_codes(findings)) == ["JIT001", "JIT002"]
+
+    def test_tracer_branch_flagged_static_allowed(self):
+        findings = jit_safety.check_file(_src(
+            "import functools\n"
+            "import jax\n"
+            "@functools.partial(jax.jit, static_argnames=('k',))\n"
+            "def f(x, k):\n"
+            "    if k > 2:\n"          # static — allowed
+            "        return x * 2\n"
+            "    if x.shape[0] > 4:\n"  # shape is static — allowed
+            "        return x\n"
+            "    if x > 0:\n"           # tracer value — flagged
+            "        return x + 1\n"
+            "    return x\n"
+        ))
+        assert _codes(findings) == ["JIT003"]
+        assert findings[0].symbol == "f:x"
+
+    def test_outside_jit_is_not_flagged(self):
+        findings = jit_safety.check_file(_src(
+            "def host(x):\n"
+            "    return float(x[0].item())\n"
+        ))
+        assert findings == []
+
+    def test_jit_wrapped_local_def_checked(self):
+        findings = jit_safety.check_file(_src(
+            "import jax\n"
+            "def factory():\n"
+            "    def inner(x):\n"
+            "        return int(x.sum())\n"
+            "    return jax.jit(inner)\n"
+        ))
+        assert _codes(findings) == ["JIT002"]
+
+    def test_donated_buffer_reuse_flagged(self):
+        findings = jit_safety.check_file(_src(
+            "import jax\n"
+            "def scatter(buf, rows, vals):\n"
+            "    return buf.at[rows].set(vals)\n"
+            "g = jax.jit(scatter, donate_argnums=(0,))\n"
+            "def use(buf, rows, vals):\n"
+            "    out = g(buf, rows, vals)\n"
+            "    return out + buf\n"  # buf was donated — invalid
+        ))
+        assert _codes(findings) == ["JIT004"]
+        assert findings[0].symbol == "use:buf"
+
+    def test_donated_rebind_is_clean(self):
+        findings = jit_safety.check_file(_src(
+            "import jax\n"
+            "def scatter(buf, rows, vals):\n"
+            "    return buf.at[rows].set(vals)\n"
+            "g = jax.jit(scatter, donate_argnums=(0,))\n"
+            "def use(buf, rows, vals):\n"
+            "    buf = g(buf, rows, vals)\n"  # rebound — fresh buffer
+            "    return buf\n"
+        ))
+        assert findings == []
+
+
+# ---- serde drift ----
+
+
+class TestSerdeDrift:
+    def test_real_tree_is_drift_free(self):
+        assert serde_drift.run(find_root()) == []
+
+    def test_unregistered_kind_missing_exemplar(self, monkeypatch):
+        from volcano_tpu.bus import protocol
+
+        monkeypatch.setitem(protocol.KINDS, "Phantom", object)
+        findings = serde_drift.run(find_root())
+        assert [f.code for f in findings] == ["SRD001"]
+        assert findings[0].symbol == "Phantom"
+
+    def test_server_op_without_version_registration(self, monkeypatch):
+        from volcano_tpu.bus import protocol
+
+        trimmed = dict(protocol.OP_VERSIONS)
+        del trimmed["commit_batch"]
+        monkeypatch.setattr(protocol, "OP_VERSIONS", trimmed)
+        findings = serde_drift.run(find_root())
+        assert [f.code for f in findings] == ["SRD002"]
+        assert findings[0].symbol == "commit_batch"
+
+    def test_post_v1_op_declared_but_unhandled_is_drift(self, monkeypatch):
+        from volcano_tpu.bus import protocol
+
+        grown = dict(protocol.OP_VERSIONS)
+        grown["watch_batch"] = 3
+        monkeypatch.setattr(protocol, "OP_VERSIONS", grown)
+        findings = serde_drift.run(find_root())
+        assert [f.code for f in findings] == ["SRD004"]
+        assert findings[0].symbol == "watch_batch"
+
+
+# ---- baseline machinery ----
+
+
+class TestBaseline:
+    def _finding(self, symbol="C.bad:_x"):
+        return Finding("lock", "LCK001", "volcano_tpu/m.py", 7, symbol, "msg")
+
+    def test_round_trip_suppresses_by_key_not_line(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        Baseline.write(path, [self._finding()])
+        data = json.load(open(path))
+        # reasons are mandatory: the writer emits a TODO the author edits
+        assert data["suppressions"][0]["reason"].startswith("TODO")
+        data["suppressions"][0]["reason"] = "known benign"
+        json.dump(data, open(path, "w"))
+        bl = Baseline.load(path)
+        moved = Finding("lock", "LCK001", "volcano_tpu/m.py", 99,
+                        "C.bad:_x", "msg")  # line drifted — still matches
+        unsup, sup, stale = bl.split([moved])
+        assert unsup == [] and sup == [moved] and stale == []
+
+    def test_stale_entry_reported(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        Baseline.write(path, [self._finding()])
+        data = json.load(open(path))
+        data["suppressions"][0]["reason"] = "obsolete"
+        json.dump(data, open(path, "w"))
+        unsup, sup, stale = Baseline.load(path).split([])
+        assert stale and stale[0]["symbol"] == "C.bad:_x"
+
+    def test_missing_reason_rejected(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        json.dump({"suppressions": [{
+            "pass": "lock", "code": "LCK001", "file": "f.py",
+            "symbol": "s", "reason": "",
+        }]}, open(path, "w"))
+        with pytest.raises(ValueError, match="reason"):
+            Baseline.load(path)
+
+
+# ---- runtime lock-order verifier ----
+
+
+class TestLockOrder:
+    def _graph(self):
+        return lock_order._Graph()
+
+    def _run_in_thread(self, fn):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join(5)
+        assert not t.is_alive()
+
+    def test_abba_interleave_detected(self):
+        g = self._graph()
+        g.register(1, "a.py:10")
+        g.register(2, "b.py:20")
+        self._run_in_thread(lambda: (
+            g.acquired(1), g.acquired(2), g.released(2), g.released(1),
+        ))
+        assert g.violations == []  # one order alone is fine
+        self._run_in_thread(lambda: (
+            g.acquired(2), g.acquired(1), g.released(1), g.released(2),
+        ))
+        assert len(g.violations) == 1
+        rendered = g.violations[0].render()
+        assert "a.py:10" in rendered and "b.py:20" in rendered
+
+    def test_consistent_order_stays_acyclic(self):
+        g = self._graph()
+        for lid in (1, 2, 3):
+            g.register(lid, f"l{lid}.py:1")
+        for _ in range(3):
+            self._run_in_thread(lambda: (
+                g.acquired(1), g.acquired(2), g.acquired(3),
+                g.released(3), g.released(2), g.released(1),
+            ))
+        assert g.violations == []
+        assert g.report()["violations"] == []
+
+    def test_rlock_reentry_is_not_an_edge(self):
+        g = self._graph()
+        g.register(1, "a.py:1")
+        self._run_in_thread(lambda: (
+            g.acquired(1), g.acquired(1), g.released(1), g.released(1),
+        ))
+        assert g.edges == {} and g.violations == []
+
+    def test_transitive_cycle_detected(self):
+        g = self._graph()
+        for lid in (1, 2, 3):
+            g.register(lid, f"l{lid}.py:1")
+        self._run_in_thread(lambda: (
+            g.acquired(1), g.acquired(2), g.released(2), g.released(1),
+        ))
+        self._run_in_thread(lambda: (
+            g.acquired(2), g.acquired(3), g.released(3), g.released(2),
+        ))
+        assert g.violations == []
+        self._run_in_thread(lambda: (
+            g.acquired(3), g.acquired(1), g.released(1), g.released(3),
+        ))
+        assert len(g.violations) == 1  # 1→2→3→1
+
+    def test_instrumented_lock_supports_condition_wait(self):
+        """The _release_save/_acquire_restore forwarding keeps
+        Condition.wait working over an instrumented RLock, and the
+        held-stack stays balanced across the wait."""
+        g = self._graph()
+        old = lock_order._graph
+        lock_order._graph = g
+        try:
+            inner = threading.RLock()
+            lk = lock_order._InstrumentedLock(inner, "fixture.py:1")
+            cv = threading.Condition(lk)
+            fired = []
+
+            def waiter():
+                with cv:
+                    got = cv.wait(timeout=5)
+                    fired.append(got)
+                assert g.held() == []
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            import time as _t
+
+            deadline = _t.monotonic() + 5
+            while not cv._waiters and _t.monotonic() < deadline:
+                _t.sleep(0.01)  # until the waiter parks in wait()
+            with cv:
+                cv.notify_all()
+            t.join(5)
+            assert fired == [True]
+            assert g.held() == []  # this thread's stack balanced too
+        finally:
+            lock_order._graph = old
+
+
+# ---- the whole-tree gate (pins every fixed violation) ----
+
+
+class TestRepoTree:
+    def test_analysis_suite_is_green_on_this_tree(self):
+        out = io.StringIO()
+        rc = analysis_main([], out=out)
+        assert rc == 0, f"analysis found regressions:\n{out.getvalue()}"
+
+    def test_partial_run_ignores_other_passes_baseline(self):
+        out = io.StringIO()
+        rc = analysis_main(["--pass", "det"], out=out)
+        assert rc == 0, out.getvalue()
+
+    def test_report_artifact_shape(self, tmp_path):
+        report = tmp_path / "findings.json"
+        rc = analysis_main(["--report", str(report)], out=io.StringIO())
+        assert rc == 0
+        data = json.loads(report.read_text())
+        assert set(data) == {"findings", "suppressed",
+                             "stale_baseline_entries"}
+        assert data["findings"] == []
+        # the one reasoned suppression (faults/watchdog fast-path read)
+        assert [s["symbol"] for s in data["suppressed"]] == [
+            "begin_cycle:_deadline_s"
+        ]

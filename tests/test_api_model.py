@@ -8,12 +8,10 @@ import pytest
 from volcano_tpu.api import (
     JobInfo,
     NamespaceCollection,
+    new_task_info,
     NodeInfo,
     TaskStatus,
-    new_task_info,
 )
-from volcano_tpu.api.resource import Resource
-from volcano_tpu.apis import core, scheduling
 from tests.builders import build_node, build_pod
 
 

@@ -4,7 +4,6 @@ gang discards, taints/labels, and capacity-pressure stop/fallback paths."""
 
 from __future__ import annotations
 
-import numpy as np
 import pytest
 
 from volcano_tpu.ops.blocked import run_packed_blocked

@@ -5,10 +5,9 @@ state, node accounting, and snapshots."""
 
 from __future__ import annotations
 
-import pytest
 
 from volcano_tpu.api import TaskStatus
-from volcano_tpu.apis import core, scheduling
+from volcano_tpu.apis import scheduling
 
 from tests.builders import (
     build_node,

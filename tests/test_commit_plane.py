@@ -21,10 +21,8 @@ commit.delay fault points.
 
 from __future__ import annotations
 
-import os
 import socket
 import time
-from collections import defaultdict
 
 import pytest
 
@@ -466,3 +464,95 @@ def test_overlap_metrics_exported(tmp_path):
         assert "volcano_commit_overlap_ratio" in rendered
     finally:
         cluster.close()
+
+
+def test_failed_status_writeback_counts_error_schedule_attempt(tmp_path):
+    """README known-gap closed (ISSUE 7): with the commit plane on, a
+    failed status writeback must count in
+    ``schedule_attempts_total{result="error"}`` — one per affected JOB —
+    not only in ``volcano_commit_failures_total{status}``.  The
+    synchronous path gets this via JobUpdater's exception handler; the
+    async path has already returned success by the time the worker sees
+    the failure, so the plane itself must account it."""
+    from volcano_tpu.api import new_task_info
+    from volcano_tpu.metrics.metrics import registry
+
+    def _attempts(result):
+        return registry._counters.get(
+            ("volcano_schedule_attempts_total", (("result", result),)), 0.0
+        )
+
+    def _status_failures():
+        return registry._counters.get(
+            ("volcano_commit_failures_total", (("kind", "status"),)), 0.0
+        )
+
+    live_task = new_task_info(
+        build_pod("ns", "present", "", {"cpu": "100m"}, group="pg-a")
+    )
+    ghost_task = new_task_info(
+        build_pod("ns", "missing", "", {"cpu": "100m"}, group="pg-b")
+    )
+
+    # ---- fast path: one coalesced frame, per-row errors attributed
+    # back to jobs (two payloads, only the second one's Event rows are
+    # rejected → exactly one error attempt, not one per failed row) ----
+    from volcano_tpu.client.apiserver import AdmissionError
+
+    api = APIServer()
+    api.create(build_pod("ns", "present", "", {"cpu": "100m"}, group="pg-a"))
+
+    def deny_ghost_events(op, obj):
+        if obj.involved_object.get("name") == "missing":
+            raise AdmissionError("audit quota exceeded")
+
+    api.register_admission("Event", "CREATE", deny_ghost_events)
+    cache = SchedulerCache(
+        client=SchedulerClient(api), pipelined_commit=True,
+    )
+    try:
+        assert cache._fast_status, "fixture must exercise the frame path"
+        ok_payload = {
+            "events": [(live_task, "Warning", "Unschedulable", "no fit")],
+            "conditions": [(live_task, "Unschedulable", "no fit")],
+            "pod_group": None,
+        }
+        bad_payload = {
+            "events": [
+                (ghost_task, "Warning", "Unschedulable", "no fit"),
+                (ghost_task, "Warning", "Unschedulable", "still none"),
+            ],
+            "conditions": [(ghost_task, "Unschedulable", "no fit")],
+            "pod_group": None,
+        }
+        err0, cf0 = _attempts("error"), _status_failures()
+        cache._run_status_items([(ok_payload, None), (bad_payload, None)])
+        assert _status_failures() == cf0 + 2  # both rejected rows counted
+        assert _attempts("error") == err0 + 1  # but ONE failed job
+    finally:
+        cache.stop_commit_plane()
+
+    # ---- slow path: a custom (non-default) updater that fails ----
+    class FailingUpdater:
+        def update_pod_condition(self, task, reason, message):
+            raise RuntimeError("writeback rejected")
+
+        def update_pod_group(self, pg):
+            raise RuntimeError("writeback rejected")
+
+    cache = SchedulerCache(
+        status_updater=FailingUpdater(), pipelined_commit=True,
+    )
+    try:
+        assert not cache._fast_status
+        err0 = _attempts("error")
+        cache._run_status_items([(dict(ok_payload), None)])
+        assert _attempts("error") == err0 + 1
+        # a doomed (fault-injected) payload counts too
+        err0 = _attempts("error")
+        cache._run_status_items([
+            (dict(ok_payload), RuntimeError("fault-injected")),
+        ])
+        assert _attempts("error") == err0 + 1
+    finally:
+        cache.stop_commit_plane()

@@ -12,8 +12,8 @@ wired with --bus).
 import yaml
 
 from volcano_tpu.deploy.package import (
-    DEFAULT_VALUES,
     apply_set,
+    DEFAULT_VALUES,
     load_values,
     merge_values,
     render,

@@ -9,13 +9,12 @@ kubelet → pod phases → lifecycle policies → job completion.
 
 from __future__ import annotations
 
-import pytest
 
 from volcano_tpu.admission import register_webhooks
 from volcano_tpu.apis import batch, core, scheduling
+from volcano_tpu.cache import SchedulerCache
 from volcano_tpu.cli import main as vtctl
 from volcano_tpu.client import ADDED, APIServer, KubeClient, MODIFIED, SchedulerClient, VolcanoClient
-from volcano_tpu.cache import SchedulerCache
 from volcano_tpu.controllers import (
     GarbageCollector,
     JobController,

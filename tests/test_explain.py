@@ -19,7 +19,7 @@ import pytest
 from volcano_tpu.actions.allocate import AllocateAction
 from volcano_tpu.actions.backfill import BackfillAction
 from volcano_tpu.actions.jax_allocate import JaxAllocateAction
-from volcano_tpu.api import FitError, TaskStatus
+from volcano_tpu.api import FitError
 from volcano_tpu.api import unschedule_info as reasons
 from volcano_tpu.api.unschedule_info import (
     FitErrors,

@@ -5,7 +5,6 @@ reproduce the exact replay's pop order on every session shape it claims
 from __future__ import annotations
 
 import numpy as np
-import pytest
 
 from volcano_tpu.actions.fast_order import try_compute_task_order
 from volcano_tpu.actions.jax_allocate import compute_task_order_replay

@@ -16,7 +16,7 @@ import numpy as np
 import pytest
 
 from volcano_tpu import faults
-from volcano_tpu.faults.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from volcano_tpu.faults.breaker import CircuitBreaker, CLOSED, HALF_OPEN, OPEN
 from volcano_tpu.faults.watchdog import CycleDeadlineExceeded
 from volcano_tpu.metrics import metrics
 

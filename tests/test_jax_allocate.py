@@ -7,8 +7,11 @@ from __future__ import annotations
 import pytest
 
 from volcano_tpu.actions.allocate import AllocateAction
-from volcano_tpu.actions.jax_allocate import JaxAllocateAction, compute_task_order
-from volcano_tpu.framework import open_session, close_session
+from volcano_tpu.actions.jax_allocate import (
+    compute_task_order,
+    JaxAllocateAction,
+)
+from volcano_tpu.framework import close_session, open_session
 
 from tests.builders import build_node, build_pod, build_pod_group, build_queue
 from tests.scheduler_helpers import make_cache, run_actions, tiers

@@ -8,19 +8,14 @@ import numpy as np
 import pytest
 
 import volcano_tpu.scheduler.util as sched_util
-from volcano_tpu.api import TaskStatus, new_task_info, NodeInfo
-from volcano_tpu.framework.arguments import Arguments
-from volcano_tpu.ops import (
-    ScoreWeights,
-    pack_session,
-    run_packed,
-)
+from volcano_tpu.api import new_task_info, NodeInfo, TaskStatus
+from volcano_tpu.ops import pack_session, run_packed, ScoreWeights
 from volcano_tpu.ops.kernels import (
     balanced_resource_score,
     binpack_score,
     least_requested_score,
 )
-from volcano_tpu.plugins.binpack import PriorityWeight, bin_packing_score
+from volcano_tpu.plugins.binpack import bin_packing_score, PriorityWeight
 from volcano_tpu.plugins.nodeorder import (
     balanced_resource_priority,
     least_requested_priority,

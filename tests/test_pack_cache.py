@@ -16,15 +16,18 @@ import copy
 import numpy as np
 import pytest
 
-from volcano_tpu.actions.jax_allocate import JaxAllocateAction, compute_task_order
+from volcano_tpu.actions.jax_allocate import (
+    compute_task_order,
+    JaxAllocateAction,
+)
 from volcano_tpu.apis import core
 from volcano_tpu.framework import close_session, open_session
 from volcano_tpu.ops.pack_cache import (
     JOB_PLANES,
     NODE_DYNAMIC_PLANES,
     NODE_STATIC_PLANES,
-    TASK_PLANES,
     PackCache,
+    TASK_PLANES,
 )
 from volcano_tpu.ops.packing import BitRegistry, pack_session
 

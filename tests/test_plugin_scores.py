@@ -9,8 +9,8 @@ import pytest
 from volcano_tpu.api import new_task_info
 from volcano_tpu.api.node_info import NodeInfo
 from volcano_tpu.plugins.binpack import (
-    PriorityWeight,
     bin_packing_score,
+    PriorityWeight,
     resource_bin_packing_score,
 )
 

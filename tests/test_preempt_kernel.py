@@ -9,10 +9,9 @@ import numpy as np
 import pytest
 
 from volcano_tpu.actions.preempt import PreemptAction
-from volcano_tpu.conf import Tier, PluginOption
+from volcano_tpu.api import TaskStatus
 from volcano_tpu.framework.framework import close_session, open_session
 from volcano_tpu.ops.preempt_pack import pack_preempt_session, preempt_dense
-from volcano_tpu.api import TaskStatus
 
 from tests.builders import (
     build_node,

@@ -9,8 +9,8 @@ import pytest
 from volcano_tpu.api.resource import (
     MIN_MEMORY,
     MIN_MILLI_CPU,
-    Resource,
     min_resource,
+    Resource,
     share,
 )
 

@@ -25,7 +25,10 @@ from jax.sharding import Mesh
 from volcano_tpu.ops.blocked import run_packed_blocked
 from volcano_tpu.ops.kernels import run_packed
 from volcano_tpu.ops.sharded import run_packed_sharded
-from volcano_tpu.ops.synthetic import generate_snapshot, generate_preempt_packed
+from volcano_tpu.ops.synthetic import (
+    generate_preempt_packed,
+    generate_snapshot,
+)
 
 pytestmark = pytest.mark.slow
 

@@ -6,13 +6,13 @@ from __future__ import annotations
 
 from volcano_tpu.apis import core, scheduling
 from volcano_tpu.apis.scheme import (
-    PodGroupV1alpha1,
-    QueueSpecV1alpha1,
-    QueueV1alpha1,
     pod_group_hub_to_v1alpha1,
     pod_group_v1alpha1_to_hub,
+    PodGroupV1alpha1,
     queue_hub_to_v1alpha1,
     queue_v1alpha1_to_hub,
+    QueueSpecV1alpha1,
+    QueueV1alpha1,
 )
 
 from tests.builders import build_node, build_pod
@@ -110,7 +110,7 @@ class TestDualInformerWire:
         cache and schedule the pod — the cache.go:393-424 behavior."""
         import time
 
-        from volcano_tpu.cmd import ControllersDaemon, SchedulerDaemon
+        from volcano_tpu.cmd import SchedulerDaemon
         from volcano_tpu.client import APIServer, KubeClient
         from tests.builders import build_node as bn
 

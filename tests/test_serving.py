@@ -10,7 +10,6 @@ from __future__ import annotations
 import time
 import urllib.request
 
-import pytest
 
 from volcano_tpu.apis import batch, core, scheduling
 from volcano_tpu.client import APIServer, KubeClient, VolcanoClient

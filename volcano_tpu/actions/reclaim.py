@@ -5,7 +5,7 @@ Reference: pkg/scheduler/actions/reclaim/reclaim.go.
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict
 
 from volcano_tpu.api import FitError, TaskStatus
 from volcano_tpu.api.resource import empty_resource

@@ -1,0 +1,44 @@
+"""Project-invariant static analysis — the staticcheck/`go vet` analogue
+for this port.
+
+The reference is ~45k LoC of Go kept honest by `go vet`, staticcheck and
+`go test -race`; the invariants this port grew instead — "guarded by
+``self._mutex``", "no wall-clock/RNG in replay-critical paths",
+"jit-cache-stable kernel signatures", "version-gated VBUS ops" — lived
+only in docstrings and reviewer memory.  This package makes them
+machine-checked:
+
+* :mod:`~volcano_tpu.analysis.lock_discipline` — attributes declared
+  ``# guarded-by: <lock>`` may only be touched inside a ``with <lock>``
+  scope (or a function annotated ``# requires-lock: <lock>``).
+* :mod:`~volcano_tpu.analysis.determinism` — replay-critical modules
+  (trace/, faults/, ops/, actions/, cache/) must not reach wall-clock,
+  unseeded RNG, or order-escaping ``set`` iteration except through the
+  explicit ``# det:`` allowlist.
+* :mod:`~volcano_tpu.analysis.jit_safety` — jitted functions keep
+  stable static signatures: no data-dependent Python branches on
+  tracers, no ``.item()`` / ``float()`` concretization inside jit, no
+  reuse of a donated buffer after the donating call.
+* :mod:`~volcano_tpu.analysis.serde_drift` — every frame kind in
+  ``bus/protocol.py`` has a serde round-trip exemplar, and every bus op
+  is version-registered (ops past ``MIN_VERSION`` must carry the
+  old-peer fallback).
+
+Run ``python -m volcano_tpu.analysis`` (or ``vtctl lint``); CI fails on
+any finding not suppressed in the checked-in ``baseline.json``.
+
+The runtime half is :mod:`~volcano_tpu.analysis.lock_order` — the
+opt-in (``VTPU_LOCK_ORDER=1``) instrumented-lock wrapper that records
+the cross-thread lock-acquisition graph during the chaos / commit-plane
+suites and fails on cycles.
+"""
+
+from volcano_tpu.analysis.core import (  # noqa: F401 — public surface
+    Baseline,
+    Finding,
+    SourceFile,
+    iter_source_files,
+    run_passes,
+)
+
+PASSES = ("lock", "det", "jit", "serde")

@@ -1,0 +1,308 @@
+"""Jit/tracer-safety pass — jitted functions must keep stable static
+signatures.
+
+Silent recompiles are the failure mode PR 4's row bucketing exists to
+prevent: a jitted kernel whose Python-level control flow depends on
+tracer *values* (not static arguments) either crashes at trace time or,
+worse, retraces per distinct shape/value and quietly destroys the warm
+jit cache.  Flagged inside any jit-wrapped function:
+
+* ``JIT001`` — ``.item()`` on an array (host sync + concretization);
+* ``JIT002`` — ``float()`` / ``int()`` / ``bool()`` on a non-constant
+  (concretizes a tracer; at best a trace-time error, at worst a silent
+  host round trip under ``jax.disable_jit``-style fallbacks);
+* ``JIT003`` — an ``if`` / ``while`` test that references a non-static
+  parameter directly (data-dependent Python branch on a tracer).
+  References through ``.shape`` / ``.ndim`` / ``.dtype`` / ``len()``
+  are static and allowed; parameters named in ``static_argnames`` /
+  ``static_argnums`` are allowed.
+* ``JIT004`` — a buffer passed to a ``donate_argnums`` position is read
+  again after the donating call (reuse-after-donate: the buffer was
+  invalidated).
+
+Jit wrappers recognized: ``@jax.jit``, ``@functools.partial(jax.jit,
+…)`` / ``@partial(jax.jit, …)``, and ``jax.jit(fn, …)`` over a local
+``def`` in the same scope.  Waiver: ``# jit-ok: <reason>`` on the line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from volcano_tpu.analysis.core import Finding, iter_source_files, SourceFile
+
+PASS = "jit"
+CODE_ITEM = "JIT001"
+CODE_CONCRETIZE = "JIT002"
+CODE_TRACER_BRANCH = "JIT003"
+CODE_DONATE_REUSE = "JIT004"
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute) and node.attr == "jit"
+        and isinstance(node.value, ast.Name) and node.value.id == "jax"
+    ) or (isinstance(node, ast.Name) and node.id == "jit")
+
+
+def _jit_call_info(call: ast.Call) -> Optional[Dict]:
+    """``jax.jit(...)`` / ``partial(jax.jit, ...)`` → {static, donate}."""
+    if _is_jax_jit(call.func):
+        args = call.args
+    elif (
+        isinstance(call.func, ast.Attribute) and call.func.attr == "partial"
+        or isinstance(call.func, ast.Name) and call.func.id == "partial"
+    ):
+        if not (call.args and _is_jax_jit(call.args[0])):
+            return None
+        args = call.args[1:]
+    else:
+        return None
+    static: Set[str] = set()
+    static_nums: Set[int] = set()
+    donate: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    static.add(el.value)
+        elif kw.arg in ("static_argnums", "donate_argnums"):
+            nums = {
+                el.value
+                for el in ast.walk(kw.value)
+                if isinstance(el, ast.Constant) and isinstance(el.value, int)
+            }
+            if kw.arg == "static_argnums":
+                static_nums = nums
+            else:
+                donate = nums
+    return {
+        "static": static, "static_nums": static_nums, "donate": donate,
+        "wrapped": args[0] if args else None,
+    }
+
+
+def _param_names(fn) -> List[str]:
+    a = fn.args
+    return [p.arg for p in (a.posonlyargs + a.args)]
+
+
+class _JitBodyChecker(ast.NodeVisitor):
+    def __init__(self, src: SourceFile, owner: str, tracer_params: Set[str],
+                 findings: List[Finding]):
+        self.src = src
+        self.owner = owner
+        self.tracer_params = tracer_params
+        self.findings = findings
+
+    def _emit(self, code: str, node: ast.AST, what: str, msg: str) -> None:
+        if self.src.marker(node.lineno, "jit-ok"):
+            return
+        self.findings.append(Finding(
+            PASS, code, self.src.rel, node.lineno,
+            f"{self.owner}:{what}", msg,
+        ))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            self._emit(
+                CODE_ITEM, node, "item",
+                "`.item()` inside a jitted function forces a host sync / "
+                "concretization",
+            )
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "int", "bool")
+            and node.args
+            and not isinstance(node.args[0], ast.Constant)
+        ):
+            self._emit(
+                CODE_CONCRETIZE, node, node.func.id,
+                f"`{node.func.id}()` on a non-constant inside jit "
+                f"concretizes a tracer — use jnp casts or hoist out of "
+                f"the jitted body",
+            )
+        self.generic_visit(node)
+
+    def _tracer_refs(self, test: ast.AST) -> List[ast.Name]:
+        """Name nodes in ``test`` that reference tracer params, minus
+        static contexts (.shape/.ndim/.dtype/len())."""
+        static_value_ids = set()
+        for sub in ast.walk(test):
+            if (
+                isinstance(sub, ast.Attribute)
+                and sub.attr in _STATIC_ATTRS
+                and isinstance(sub.value, ast.Name)
+            ):
+                static_value_ids.add(id(sub.value))
+            elif (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id in ("len", "isinstance", "type")
+            ):
+                for a in sub.args:
+                    if isinstance(a, ast.Name):
+                        static_value_ids.add(id(a))
+            elif (
+                isinstance(sub, ast.Compare)
+                and any(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in sub.ops)
+            ):
+                # `x is None` checks identity, never a tracer value
+                for a in [sub.left] + sub.comparators:
+                    if isinstance(a, ast.Name):
+                        static_value_ids.add(id(a))
+        return [
+            n for n in ast.walk(test)
+            if isinstance(n, ast.Name)
+            and n.id in self.tracer_params
+            and id(n) not in static_value_ids
+        ]
+
+    def visit_If(self, node: ast.If) -> None:
+        for ref in self._tracer_refs(node.test):
+            self._emit(
+                CODE_TRACER_BRANCH, node, ref.id,
+                f"Python `if` on tracer parameter `{ref.id}` — "
+                f"data-dependent branch retraces per value (use "
+                f"jnp.where / lax.cond, or declare it in static_argnames)",
+            )
+            break
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        for ref in self._tracer_refs(node.test):
+            self._emit(
+                CODE_TRACER_BRANCH, node, ref.id,
+                f"Python `while` on tracer parameter `{ref.id}` — use "
+                f"lax.while_loop or declare it static",
+            )
+            break
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested defs inherit the tracer params via closure
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _check_jit_body(src: SourceFile, fn, info: Dict,
+                    findings: List[Finding]) -> None:
+    params = _param_names(fn)
+    static = set(info["static"])
+    for i in info["static_nums"]:
+        if 0 <= i < len(params):
+            static.add(params[i])
+    tracer_params = {p for p in params if p not in static}
+    checker = _JitBodyChecker(
+        src, fn.name, tracer_params, findings,
+    )
+    for stmt in fn.body:
+        checker.visit(stmt)
+
+
+class _DonateTracker(ast.NodeVisitor):
+    """Flag reads of a Name after it was passed in a donated position of
+    a known donating callable (straight-line, per enclosing function)."""
+
+    def __init__(self, src: SourceFile, donating: Dict[str, Set[int]],
+                 findings: List[Finding]):
+        self.src = src
+        self.donating = donating
+        self.findings = findings
+
+    def _scan_linear(self, owner: str, body: List[ast.stmt]) -> None:
+        #: name → line of the call that donated it
+        donated: Dict[str, Tuple[int, str]] = {}
+        for node in body:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    if isinstance(sub.ctx, ast.Store):
+                        donated.pop(sub.id, None)  # rebound — fresh value
+                    elif sub.id in donated:
+                        at, callee = donated.pop(sub.id)
+                        if not self.src.marker(sub.lineno, "jit-ok"):
+                            self.findings.append(Finding(
+                                PASS, CODE_DONATE_REUSE, self.src.rel,
+                                sub.lineno, f"{owner}:{sub.id}",
+                                f"`{sub.id}` was donated to `{callee}` at "
+                                f"line {at} and read again — the donated "
+                                f"buffer is invalid after the call",
+                            ))
+            # donations recorded AFTER scanning the node, so the call's
+            # own argument read does not self-flag; an Assign target that
+            # re-binds the donated name (buf = fn(buf, ...)) already
+            # cleared it above via the Store visit ordering… walk order
+            # is not guaranteed, so handle the common rebind explicitly:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                    nums = self.donating.get(sub.func.id)
+                    if not nums:
+                        continue
+                    for i, arg in enumerate(sub.args):
+                        if i in nums and isinstance(arg, ast.Name):
+                            donated[arg.id] = (sub.lineno, sub.func.id)
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        donated.pop(t.id, None)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._scan_linear(node.name, node.body)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def check_file(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    #: name → donate_argnums for jit-wrapped callables bound in this file
+    donating: Dict[str, Set[int]] = {}
+    defs: Dict[int, ast.FunctionDef] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                info = _jit_call_info(dec) if isinstance(dec, ast.Call) \
+                    else ({"static": set(), "static_nums": set(),
+                           "donate": set(), "wrapped": None}
+                          if _is_jax_jit(dec) else None)
+                if info is not None:
+                    _check_jit_body(src, node, info, findings)
+                    if info["donate"]:
+                        donating[node.name] = info["donate"]
+        elif isinstance(node, ast.Call):
+            info = _jit_call_info(node)
+            if info is None or info["wrapped"] is None:
+                continue
+            wrapped = info["wrapped"]
+            if isinstance(wrapped, ast.Name) and wrapped.id in defs:
+                _check_jit_body(src, defs[wrapped.id], info, findings)
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            # `g = jax.jit(f, donate_argnums=…)` — call sites donate
+            # through the ASSIGNED name, so that is what the
+            # reuse-after-donate tracker must watch
+            info = _jit_call_info(node.value)
+            if info is None or not info["donate"]:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    donating[t.id] = set(info["donate"])
+    if donating:
+        _DonateTracker(src, donating, findings).visit(src.tree)
+    return findings
+
+
+def run(root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in iter_source_files(root, subdirs=("volcano_tpu/",)):
+        findings.extend(check_file(src))
+    return findings

@@ -1,0 +1,196 @@
+"""VBUS serde/version-drift pass — the v1-stamping rule PR 6's review
+caught by hand, made machine-checked.
+
+Four invariants over the bus protocol surface:
+
+* ``SRD001`` — every object kind registered in
+  ``bus/protocol.py::KINDS`` has a serde round-trip exemplar in
+  ``tests/test_bus.py::SERDE_EXEMPLARS`` (the parameterized round-trip
+  test covers exactly that mapping, so a kind added to the registry
+  without a fixture fails the lint before it fails in production).
+* ``SRD002`` — every op the server dispatches
+  (``bus/server.py::_execute``) is version-registered in
+  ``protocol.OP_VERSIONS``.  An unregistered op has no declared
+  compatibility story.
+* ``SRD003`` — every op introduced after ``MIN_VERSION`` must be
+  version-gated on the client: the ``bus/remote.py`` method that sends
+  it must carry the old-peer fallback (textually, it handles the
+  ``unknown bus op`` typed error).  Version skew costs throughput,
+  never correctness.
+* ``SRD004`` — an op the client sends that the server does not handle
+  (or vice versa: a registered op nobody dispatches) is drift between
+  the two halves of the protocol.
+
+This pass imports ``volcano_tpu.bus.protocol`` (our own package — the
+registries are the source of truth) and parses ``server.py`` /
+``remote.py`` / the test module as AST.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set
+
+from volcano_tpu.analysis.core import Finding, SourceFile
+
+PASS = "serde"
+CODE_NO_ROUNDTRIP = "SRD001"
+CODE_UNREGISTERED_OP = "SRD002"
+CODE_UNGATED_OP = "SRD003"
+CODE_OP_DRIFT = "SRD004"
+
+_PROTO = "volcano_tpu/bus/protocol.py"
+_SERVER = "volcano_tpu/bus/server.py"
+_REMOTE = "volcano_tpu/bus/remote.py"
+_TESTS = "tests/test_bus.py"
+
+
+def _load(root: str, rel: str) -> Optional[SourceFile]:
+    path = os.path.join(root, rel)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return SourceFile(path, rel, f.read())
+
+
+def _server_ops(src: SourceFile) -> Set[str]:
+    """String constants compared against ``op`` in ``_execute``."""
+    ops: Set[str] = set()
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.FunctionDef) and node.name == "_execute"):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Compare) and len(sub.comparators) == 1:
+                left, right = sub.left, sub.comparators[0]
+                for a, b in ((left, right), (right, left)):
+                    if (
+                        isinstance(a, ast.Name) and a.id == "op"
+                        and isinstance(b, ast.Constant)
+                        and isinstance(b.value, str)
+                    ):
+                        ops.add(b.value)
+    return ops
+
+
+def _client_ops(src: SourceFile) -> dict:
+    """op name → enclosing function source text, for every
+    ``{"op": "<name>", ...}`` payload literal in remote.py."""
+    ops = {}
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        fn_src = ast.get_source_segment(src.text, node) or ""
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Dict):
+                continue
+            for k, v in zip(sub.keys, sub.values):
+                if (
+                    isinstance(k, ast.Constant) and k.value == "op"
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)
+                ):
+                    # outermost enclosing function wins (first visit)
+                    ops.setdefault(v.value, fn_src)
+    return ops
+
+
+def _exemplar_kinds(src: SourceFile) -> Optional[Set[str]]:
+    """Keys of the module-level ``SERDE_EXEMPLARS`` mapping, or None
+    when the mapping does not exist at all."""
+    for node in src.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "SERDE_EXEMPLARS":
+                keys: Set[str] = set()
+                if isinstance(value, ast.Dict):
+                    for k in value.keys:
+                        if isinstance(k, ast.Constant) and isinstance(
+                            k.value, str
+                        ):
+                            keys.add(k.value)
+                return keys
+    return None
+
+
+def run(root: str) -> List[Finding]:
+    from volcano_tpu.bus import protocol
+
+    findings: List[Finding] = []
+
+    # ---- SRD001: round-trip exemplar per registered kind ----
+    # Judged only when the tests tree is present (a repo checkout).  An
+    # installed package has no tests/ directory — flagging every kind
+    # there would make `vtctl lint` unusable outside the repo.
+    tests = _load(root, _TESTS)
+    if tests is not None:
+        exemplars = _exemplar_kinds(tests)
+        for kind in sorted(protocol.KINDS):
+            if exemplars is None or kind not in exemplars:
+                findings.append(Finding(
+                    PASS, CODE_NO_ROUNDTRIP, _TESTS, 1, kind,
+                    f"kind `{kind}` is registered in bus/protocol.py "
+                    f"KINDS but has no serde round-trip exemplar in "
+                    f"{_TESTS}::SERDE_EXEMPLARS",
+                ))
+
+    # ---- op registries ----
+    op_versions = getattr(protocol, "OP_VERSIONS", None)
+    server = _load(root, _SERVER)
+    remote = _load(root, _REMOTE)
+    server_ops = _server_ops(server) if server is not None else set()
+    client_ops = _client_ops(remote) if remote is not None else {}
+
+    if op_versions is None:
+        for op in sorted(server_ops):
+            findings.append(Finding(
+                PASS, CODE_UNREGISTERED_OP, _PROTO, 1, op,
+                "bus/protocol.py declares no OP_VERSIONS registry — every "
+                "op needs a declared protocol version",
+            ))
+        return findings
+
+    # SRD002: server dispatches an op with no declared version
+    for op in sorted(server_ops - set(op_versions)):
+        findings.append(Finding(
+            PASS, CODE_UNREGISTERED_OP, _SERVER, 1, op,
+            f"server dispatches op `{op}` but protocol.OP_VERSIONS does "
+            f"not declare its introduction version",
+        ))
+
+    # SRD003: post-v1 ops must carry the old-peer fallback client-side
+    for op, version in sorted(op_versions.items()):
+        if version <= protocol.MIN_VERSION:
+            continue
+        fn_src = client_ops.get(op)
+        if fn_src is not None and "unknown bus op" not in fn_src:
+            findings.append(Finding(
+                PASS, CODE_UNGATED_OP, _REMOTE, 1, op,
+                f"op `{op}` was introduced at protocol v{version} > "
+                f"MIN_VERSION={protocol.MIN_VERSION} but the client "
+                f"method sending it has no old-peer fallback (must "
+                f"handle the `unknown bus op` typed error)",
+            ))
+
+    # SRD004: drift between the two halves
+    for op in sorted(set(client_ops) - server_ops):
+        findings.append(Finding(
+            PASS, CODE_OP_DRIFT, _REMOTE, 1, op,
+            f"client sends op `{op}` that bus/server.py _execute never "
+            f"dispatches",
+        ))
+    for op in sorted(set(op_versions) - server_ops):
+        findings.append(Finding(
+            PASS, CODE_OP_DRIFT, _PROTO, 1, op,
+            f"protocol.OP_VERSIONS declares op `{op}` that bus/server.py "
+            f"_execute never dispatches",
+        ))
+    return findings

@@ -7,8 +7,12 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-from volcano_tpu.api.resource import Resource, empty_resource
-from volcano_tpu.api.types import ALLOCATED_STATUSES, TaskStatus, allocated_status
+from volcano_tpu.api.resource import empty_resource, Resource
+from volcano_tpu.api.types import (
+    allocated_status,
+    ALLOCATED_STATUSES,
+    TaskStatus,
+)
 from volcano_tpu.api.unschedule_info import FitErrors
 from volcano_tpu.apis import core, scheduling
 

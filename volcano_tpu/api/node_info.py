@@ -7,9 +7,9 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-from volcano_tpu.api.resource import Resource, empty_resource
-from volcano_tpu.api.types import NodePhase, TaskStatus
 from volcano_tpu.api.job_info import TaskInfo
+from volcano_tpu.api.resource import empty_resource, Resource
+from volcano_tpu.api.types import NodePhase, TaskStatus
 from volcano_tpu.apis import core
 
 

@@ -5,10 +5,9 @@ Reference: pkg/scheduler/api/queue_info.go and namespace_info.go.
 
 from __future__ import annotations
 
-import heapq
 from typing import Dict, Optional
 
-from volcano_tpu.apis import core, scheduling
+from volcano_tpu.apis import scheduling
 
 DEFAULT_NAMESPACE_WEIGHT = 1
 NAMESPACE_WEIGHT_KEY = "namespace.weight"

@@ -10,7 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from volcano_tpu.apis.core import K8sObject, PodTemplateSpec, Volume
+from volcano_tpu.apis.core import K8sObject, PodTemplateSpec
 
 # ---- Lifecycle events (job.go:124-144) ----
 ANY_EVENT = "*"

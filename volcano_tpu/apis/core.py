@@ -8,7 +8,6 @@ k8s dict schema and interpreted by the predicate/score layers.
 
 from __future__ import annotations
 
-import dataclasses
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 

@@ -21,7 +21,7 @@ scheme.Convert does through the hub types.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict
 
 from volcano_tpu.apis import scheduling
 from volcano_tpu.apis.core import K8sObject

@@ -84,6 +84,25 @@ KINDS: Dict[str, type] = {
     )
 }
 
+#: request-op → protocol version that introduced it.  The compatibility
+#: registry the serde-drift lint (volcano_tpu/analysis/serde_drift.py)
+#: checks: every op the server dispatches must be declared here, and an
+#: op introduced after MIN_VERSION must carry the client-side old-peer
+#: fallback (the ``unknown bus op`` typed-error path) — the v1-stamping
+#: rule PR 6's review enforced by hand.
+OP_VERSIONS: Dict[str, int] = {
+    "create": 1,
+    "update": 1,
+    "update_status": 1,
+    "get": 1,
+    "list": 1,
+    "delete": 1,
+    "watch": 1,
+    "unwatch": 1,
+    "register_admission": 1,
+    "commit_batch": 2,
+}
+
 #: wire error name → exception class; unknown names fall back to ApiError
 ERRORS: Dict[str, type] = {
     cls.__name__: cls

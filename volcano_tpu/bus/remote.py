@@ -112,16 +112,16 @@ class RemoteAPIServer:
         self._connected = threading.Event()
         self._ever_connected = False
 
-        self._req_id = 0
-        self._watch_id = 0
+        self._req_id = 0  # guarded-by: self._id_lock
+        self._watch_id = 0  # guarded-by: self._id_lock
         self._id_lock = threading.Lock()
         #: req_id → {"event", "result", "error"}
-        self._pending: Dict[int, dict] = {}
+        self._pending: Dict[int, dict] = {}  # guarded-by: self._pending_lock
         self._pending_lock = threading.Lock()
 
         self._watch_lock = threading.Lock()
-        self._watches: Dict[str, _WatchState] = {}
-        self._by_watch_id: Dict[int, _WatchState] = {}
+        self._watches: Dict[str, _WatchState] = {}  # guarded-by: self._watch_lock
+        self._by_watch_id: Dict[int, _WatchState] = {}  # guarded-by: self._watch_lock
 
         #: (kind, operation) → [hook]; replayed to the server on connect
         self._admission: Dict[Tuple[str, str], List] = {}
@@ -265,15 +265,21 @@ class RemoteAPIServer:
             elif mtype == protocol.T_ERROR:
                 self._resolve(corr_id, None, payload)
             elif mtype == protocol.T_WATCH_EVENT:
-                state = self._by_watch_id.get(corr_id)
+                state = self._watch_state(corr_id)
                 if state is not None:
                     self._dispatch_q.put(("event", state, payload))
             elif mtype == protocol.T_BOOKMARK:
-                state = self._by_watch_id.get(corr_id)
+                state = self._watch_state(corr_id)
                 if state is not None:
                     self._dispatch_q.put(("bookmark", state, payload))
             elif mtype == protocol.T_ADMIT_REQ:
                 self._admit_q.put((corr_id, payload))
+
+    def _watch_state(self, watch_id: int) -> Optional[_WatchState]:
+        # the reader thread races watch()/unwatch teardown on other
+        # threads — the bare dict read was the lock lint's catch
+        with self._watch_lock:
+            return self._by_watch_id.get(watch_id)
 
     def _resolve(self, req_id: int, result, error) -> None:
         with self._pending_lock:
@@ -503,7 +509,8 @@ class RemoteAPIServer:
             if fresh:
                 with self._id_lock:
                     self._watch_id += 1
-                state = _WatchState(kind, self._watch_id)
+                    wid = self._watch_id
+                state = _WatchState(kind, wid)
                 self._watches[kind] = state
                 self._by_watch_id[state.watch_id] = state
         # handler registration goes through the dispatch queue so its

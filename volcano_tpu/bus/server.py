@@ -150,22 +150,22 @@ class BusServer:
         #: from another incarnation can never be judged against our
         #: sequence numbers, so it is answered with relist-required.
         self.epoch = uuid.uuid4().hex
-        self._seq = 0
-        self._backlog: List[dict] = []
+        self._seq = 0  # guarded-by: self.api.locked()
+        self._backlog: List[dict] = []  # guarded-by: self.api.locked()
         #: kind → [(conn, watch_id)] live subscriptions
-        self._subs: Dict[str, List[Tuple[_Conn, int]]] = {}
+        self._subs: Dict[str, List[Tuple[_Conn, int]]] = {}  # guarded-by: self.api.locked()
         #: (kind, operation) → [conn] remote admission registrations;
         #: guarded by _admission_lock — a reconnecting webhook races its
         #: old connection's cleanup, and an unguarded prune-empty-key
         #: could strand the fresh registration on an orphaned list
-        self._admission: Dict[Tuple[str, str], List[_Conn]] = {}
+        self._admission: Dict[Tuple[str, str], List[_Conn]] = {}  # guarded-by: self._admission_lock
         self._admission_lock = threading.Lock()
-        self._review_id = 0
+        self._review_id = 0  # guarded-by: self._review_lock
         self._review_lock = threading.Lock()
         self._central_watchers: List[Tuple[str, object]] = []
         self._listener: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
-        self._conns: List[_Conn] = []
+        self._conns: List[_Conn] = []  # guarded-by: self._conns_lock
         self._conns_lock = threading.Lock()
         self._stop = threading.Event()
 
@@ -239,6 +239,9 @@ class BusServer:
         from volcano_tpu import faults
 
         def on_event(event, old, new):
+            # requires-lock: self.api.locked()
+            # (store watchers fire under the store lock — the
+            # _notify discipline documented on APIServer.locked)
             self._seq += 1
             entry = {
                 "seq": self._seq,
@@ -363,6 +366,7 @@ class BusServer:
                     self._admission.pop(key, None)
 
     def _update_watcher_gauge(self) -> None:
+        # requires-lock: self.api.locked()
         metrics.update_bus_server_watchers(
             sum(len(s) for s in self._subs.values())
         )
@@ -449,7 +453,7 @@ class BusServer:
             return None  # responses pushed inline for ordering
         if op == "unwatch":
             watch_id = int(payload["watch_id"])
-            with api.locked():
+            with self.api.locked():
                 kind = conn.watches.pop(watch_id, None)
                 if kind is not None:
                     subs = self._subs.get(kind, [])

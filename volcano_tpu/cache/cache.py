@@ -16,11 +16,11 @@ from typing import Dict, List, Optional
 from volcano_tpu.api import (
     ClusterInfo,
     JobInfo,
+    new_task_info,
     NodeInfo,
     QueueInfo,
     TaskInfo,
     TaskStatus,
-    new_task_info,
 )
 from volcano_tpu.api.job_info import get_job_id
 from volcano_tpu.api.queue_info import NamespaceCollection
@@ -165,13 +165,13 @@ class SchedulerCache(Cache):
         self.default_queue = default_queue
         self.default_priority = default_priority
 
-        self.jobs: Dict[str, JobInfo] = {}
-        self.nodes: Dict[str, NodeInfo] = {}
-        self.queues: Dict[str, QueueInfo] = {}
-        self.priority_classes: Dict[str, core.PriorityClass] = {}
-        self.namespace_collections: Dict[str, NamespaceCollection] = {}
+        self.jobs: Dict[str, JobInfo] = {}  # guarded-by: self._mutex
+        self.nodes: Dict[str, NodeInfo] = {}  # guarded-by: self._mutex
+        self.queues: Dict[str, QueueInfo] = {}  # guarded-by: self._mutex
+        self.priority_classes: Dict[str, core.PriorityClass] = {}  # guarded-by: self._mutex
+        self.namespace_collections: Dict[str, NamespaceCollection] = {}  # guarded-by: self._mutex
         #: PVCs keyed "ns/name" (pvcInformer, cache.go:415-421)
-        self.pvcs: Dict[str, core.PersistentVolumeClaim] = {}
+        self.pvcs: Dict[str, core.PersistentVolumeClaim] = {}  # guarded-by: self._mutex
 
         self.client = client
         self.binder = binder or (DefaultBinder(client) if client else None)
@@ -185,7 +185,7 @@ class SchedulerCache(Cache):
         #: ``[task, attempts, next_try_monotonic]``; uids are deduped
         #: (the reference's workqueue semantics) so a bind burst cannot
         #: enqueue the same task N times.
-        self.err_tasks: List[list] = []
+        self.err_tasks: List[list] = []  # guarded-by: self._mutex
         #: uid → [task, quarantined_at_monotonic] for entries that
         #: exhausted _RESYNC_MAX_RETRIES: requeueing such a poison task
         #: hot-loop forever would grind the queue (the pre-fix
@@ -197,12 +197,12 @@ class SchedulerCache(Cache):
         #: cached task in Binding permanently.  Visible via the
         #: ResyncFailed Warning Event and the
         #: volcano_resync_quarantined_tasks gauge.
-        self.quarantined_tasks: Dict[str, list] = {}
+        self.quarantined_tasks: Dict[str, list] = {}  # guarded-by: self._mutex
         #: uids popped from err_tasks whose (blocking, mutex-free) fetch
         #: is in flight — resync_task dedupes against this too, or a
         #: concurrent enqueue during the fetch window would mint a
         #: duplicate entry
-        self._resync_inflight: set = set()
+        self._resync_inflight: set = set()  # guarded-by: self._mutex
         #: one-shot flag for the "client can't record events" warning
         self._warned_no_events = False
         #: job uid → latest unschedulable writeback digest.  Fit errors
@@ -211,21 +211,21 @@ class SchedulerCache(Cache):
         #: them — it parks a digest here for the /explain debug surface.
         #: Cleared when the job's writeback carries no pending fit
         #: errors anymore, and when the job leaves the cache.
-        self.unschedulable_digest: Dict[str, dict] = {}
+        self.unschedulable_digest: Dict[str, dict] = {}  # guarded-by: self._mutex
 
         # ---- warm-cycle change tracking (ops/pack_cache.py) ----
         #: bumped on every pack-relevant mutation; the dirty dicts map
         #: uid/name → the revision that last dirtied it, so consumers can
         #: acknowledge a prefix without losing later invalidations
-        self._rev = 0
-        self._topology_rev = 0
-        self._dirty_tasks: Dict[str, int] = {}
-        self._dirty_nodes: Dict[str, int] = {}
-        self._dirty_nodes_full: Dict[str, int] = {}
+        self._rev = 0  # guarded-by: self._mutex
+        self._topology_rev = 0  # guarded-by: self._mutex
+        self._dirty_tasks: Dict[str, int] = {}  # guarded-by: self._mutex
+        self._dirty_nodes: Dict[str, int] = {}  # guarded-by: self._mutex
+        self._dirty_nodes_full: Dict[str, int] = {}  # guarded-by: self._mutex
         #: per-object last-mutation revision (never cleared — validity
         #: stamps for the opt-in snapshot clone pool below)
-        self._job_mut_rev: Dict[str, int] = {}
-        self._node_mut_rev: Dict[str, int] = {}
+        self._job_mut_rev: Dict[str, int] = {}  # guarded-by: self._mutex
+        self._node_mut_rev: Dict[str, int] = {}  # guarded-by: self._mutex
         #: lazily built cycle-persistent packer; jax-allocate picks it up
         #: through the session's cache reference
         self._pack_cache = None
@@ -329,24 +329,29 @@ class SchedulerCache(Cache):
     # ---- warm-cycle change tracking ----
 
     def _mark_task(self, uid: str) -> None:
+        # requires-lock: self._mutex
         self._rev += 1
         self._dirty_tasks[uid] = self._rev
 
     def _mark_node(self, name: str) -> None:
+        # requires-lock: self._mutex
         self._rev += 1
         self._dirty_nodes[name] = self._rev
         self._node_mut_rev[name] = self._rev
 
     def _mark_node_full(self, name: str) -> None:
+        # requires-lock: self._mutex
         """Node OBJECT change: static packed planes invalidate too."""
         self._mark_node(name)
         self._dirty_nodes_full[name] = self._rev
 
     def _mark_job(self, uid: str) -> None:
+        # requires-lock: self._mutex
         self._rev += 1
         self._job_mut_rev[uid] = self._rev
 
     def _mark_topology(self) -> None:
+        # requires-lock: self._mutex
         self._rev += 1
         self._topology_rev = self._rev
 
@@ -357,6 +362,7 @@ class SchedulerCache(Cache):
     _DIRTY_CAP = 250_000
 
     def _bound_dirty(self) -> None:
+        # requires-lock: self._mutex
         if (
             len(self._dirty_tasks) > self._DIRTY_CAP
             or len(self._dirty_nodes) > self._DIRTY_CAP
@@ -395,6 +401,7 @@ class SchedulerCache(Cache):
     # ---- event handlers: pods (event_handlers.go:39-254) ----
 
     def _get_or_create_job(self, ti: TaskInfo) -> Optional[JobInfo]:
+        # requires-lock: self._mutex
         """event_handlers.go:44-58 — only pods carrying a PodGroup
         annotation get a job; others are node-accounting-only."""
         if not ti.job:
@@ -404,6 +411,7 @@ class SchedulerCache(Cache):
         return self.jobs[ti.job]
 
     def _add_task(self, ti: TaskInfo) -> None:
+        # requires-lock: self._mutex
         """event_handlers.go:60-79."""
         job = self._get_or_create_job(ti)
         if job is not None:
@@ -426,6 +434,7 @@ class SchedulerCache(Cache):
                     log.debug("add task to node: %s", e)
 
     def _delete_task(self, ti: TaskInfo) -> None:
+        # requires-lock: self._mutex
         """event_handlers.go:126-151."""
         if ti.job and ti.job in self.jobs:
             job = self.jobs[ti.job]
@@ -731,6 +740,7 @@ class SchedulerCache(Cache):
     # ---- side effects (cache.go:498-615) ----
 
     def _find_job_and_task(self, task_info: TaskInfo):
+        # requires-lock: self._mutex
         job = self.jobs.get(task_info.job)
         if job is None:
             raise KeyError(f"failed to find job {task_info.job}")
@@ -1171,7 +1181,11 @@ class SchedulerCache(Cache):
             if expired:
                 self._update_quarantine_gauge()
         drain_deadline = now + self._RESYNC_DRAIN_BUDGET_S
-        for _ in range(min(len(self.err_tasks), self._RESYNC_DRAIN_MAX)):
+        # bounded by _RESYNC_DRAIN_MAX alone: each due iteration pops one
+        # entry, and the due-check exits when the queue has nothing left
+        # (the old `min(len(self.err_tasks), …)` pre-read touched the
+        # guarded queue outside the mutex — the lint's first catch)
+        for _ in range(self._RESYNC_DRAIN_MAX):
             with self._mutex:
                 due = any(e[2] <= _time.monotonic() for e in self.err_tasks)
             if not due or _time.monotonic() >= drain_deadline:
@@ -1179,12 +1193,13 @@ class SchedulerCache(Cache):
             self.process_resync_task()
 
     def _update_quarantine_gauge(self) -> None:
-        # caller holds the mutex
+        # requires-lock: self._mutex
         from volcano_tpu.metrics import metrics
 
         metrics.update_resync_quarantined(len(self.quarantined_tasks))
 
     def _clear_quarantine(self, uid: str) -> None:
+        # requires-lock: self._mutex
         """Fresh API truth for a quarantined task's pod arrived through
         the watch — the quarantine's exit condition."""
         if self.quarantined_tasks.pop(uid, None) is not None:
@@ -1285,19 +1300,39 @@ class SchedulerCache(Cache):
             self._commit_plane.submit_status(payload)
         return job.pod_group
 
+    @staticmethod
+    def _fail_status_attempts(n: int) -> None:
+        """A failed async status writeback is a failed schedule attempt
+        for each affected JOB: the synchronous path's JobUpdater
+        converts its exception into ``schedule_attempts_total{error}``,
+        but with the commit plane on, JobUpdater already returned
+        success by the time the worker sees the failure — so the plane
+        counts the error attempts itself (one per job payload), landing
+        before the commit barrier releases the next cycle.  Closes the
+        README known-gap where these failures were visible only in
+        ``volcano_commit_failures_total``."""
+        from volcano_tpu.metrics import metrics
+
+        for _ in range(n):
+            metrics.register_schedule_attempt("error")
+
     def _run_status_items(self, items) -> None:
-        """Land ``[(payload, doomed)]`` status-writeback items.  Fast
-        path: the whole batch of jobs becomes one commit frame (events +
-        conditions + PodGroup statuses).  Slow path: the per-object
-        calls the synchronous writeback makes.  Failures are logged and
-        counted — the next cycle's updater recomputes and retries, the
-        same convergence a synchronous writeback error relies on."""
+        """Land ``[(payload, doomed)]`` status-writeback items (one
+        payload = one job's whole writeback).  Fast path: the batch of
+        jobs becomes one commit frame (events + conditions + PodGroup
+        statuses).  Slow path: the per-object calls the synchronous
+        writeback makes.  Failures are logged and counted — both in
+        ``volcano_commit_failures_total{status}`` and as one
+        ``schedule_attempts_total{error}`` per affected job — and the
+        next cycle's updater recomputes and retries, the same
+        convergence a synchronous writeback error relies on."""
         from volcano_tpu.metrics import metrics
 
         live = []
         for payload, doom in items:
             if doom is not None:
                 metrics.register_commit_failure("status")
+                self._fail_status_attempts(1)
                 log.error("status writeback dropped by injected fault; "
                           "next cycle retries")
                 continue
@@ -1305,41 +1340,60 @@ class SchedulerCache(Cache):
         if not live:
             return
         if self._fast_status:
-            events = [
-                {
-                    "namespace": t.namespace,
-                    "involved": {"kind": "Pod", "namespace": t.namespace,
-                                 "name": t.name},
-                    "type": type_, "reason": reason, "message": message,
-                }
-                for p in live
-                for t, type_, reason, message in p["events"]
-            ]
-            conditions = [
-                {"namespace": t.namespace, "name": t.name,
-                 "reason": reason, "message": message}
-                for p in live
-                for t, reason, message in p["conditions"]
-            ]
-            pod_groups = [p["pod_group"] for p in live
-                          if p["pod_group"] is not None]
+            # flatten the per-job payloads into one frame, remembering
+            # which job each frame row came from so per-row errors can
+            # be attributed back (one error ATTEMPT per failed job, no
+            # matter how many of its rows failed)
+            events, conditions, pod_groups = [], [], []
+            ev_owner, cond_owner, pg_owner = [], [], []
+            for pi, p in enumerate(live):
+                for t, type_, reason, message in p["events"]:
+                    events.append({
+                        "namespace": t.namespace,
+                        "involved": {"kind": "Pod",
+                                     "namespace": t.namespace,
+                                     "name": t.name},
+                        "type": type_, "reason": reason,
+                        "message": message,
+                    })
+                    ev_owner.append(pi)
+                for t, reason, message in p["conditions"]:
+                    conditions.append({
+                        "namespace": t.namespace, "name": t.name,
+                        "reason": reason, "message": message,
+                    })
+                    cond_owner.append(pi)
+                if p["pod_group"] is not None:
+                    pod_groups.append(p["pod_group"])
+                    pg_owner.append(pi)
             try:
                 results = self.client.commit_batch(
                     events=events, conditions=conditions,
                     pod_groups=pod_groups,
                 )
-            except Exception as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001 — frame-level failure:
+                # every job's writeback was lost
                 metrics.register_commit_failure("status")
+                self._fail_status_attempts(len(live))
                 log.error("batched status writeback failed: %s", e)
                 return
-            for section in ("events", "conditions", "pod_groups"):
-                for err in results.get(section, ()):
+            failed_jobs = set()
+            for section, owners in (
+                ("events", ev_owner),
+                ("conditions", cond_owner),
+                ("pod_groups", pg_owner),
+            ):
+                for i, err in enumerate(results.get(section, ())):
                     if err is not None:
                         metrics.register_commit_failure("status")
+                        if i < len(owners):
+                            failed_jobs.add(owners[i])
                         log.error("status writeback %s failed: %s",
                                   section, err)
+            self._fail_status_attempts(len(failed_jobs))
             return
         for p in live:
+            failed = False
             for t, type_, reason, message in p["events"]:
                 self._record_event(t, type_, reason, message)
             for t, reason, message in p["conditions"]:
@@ -1349,10 +1403,14 @@ class SchedulerCache(Cache):
                     )
                 except Exception as e:  # noqa: BLE001
                     metrics.register_commit_failure("status")
+                    failed = True
                     log.error("update pod condition failed: %s", e)
             if p["pod_group"] is not None and self.status_updater is not None:
                 try:
                     self.status_updater.update_pod_group(p["pod_group"])
                 except Exception as e:  # noqa: BLE001
                     metrics.register_commit_failure("status")
+                    failed = True
                     log.error("update pod group failed: %s", e)
+            if failed:
+                self._fail_status_attempts(1)

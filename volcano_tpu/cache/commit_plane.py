@@ -66,14 +66,14 @@ class CommitPlane:
         self._cv = threading.Condition()
         #: ("bind", task, hostname, doomed) | ("evict", task, reason,
         #: doomed) | ("status", payload, doomed)
-        self._items: deque = deque()
-        self._inflight = 0
-        self._stopped = False
+        self._items: deque = deque()  # guarded-by: self._cv
+        self._inflight = 0  # guarded-by: self._cv
+        self._stopped = False  # guarded-by: self._cv
         #: WALL-CLOCK time the plane was active (≥1 worker draining)
         #: since the last barrier — summed per-worker busy time would
         #: overstate overlap whenever workers drain concurrently
-        self._busy_s = 0.0
-        self._active_since: Optional[float] = None
+        self._busy_s = 0.0  # guarded-by: self._cv
+        self._active_since: Optional[float] = None  # guarded-by: self._cv
         #: read by bench/observability after a barrier
         self.last_barrier: Dict[str, float] = {}
         self._threads = [
@@ -134,7 +134,7 @@ class CommitPlane:
             self._update_depth()
 
     def _update_depth(self) -> None:
-        # caller holds the condition lock
+        # requires-lock: self._cv
         metrics.update_commit_queue_depth(len(self._items) + self._inflight)
 
     @property

@@ -21,13 +21,12 @@ trace JSON.
 from __future__ import annotations
 
 import argparse
-import io
 import sys
 import time
 from typing import Dict, List, Optional
 
 from volcano_tpu.apis import batch, bus, core, scheduling
-from volcano_tpu.client import APIServer, ApiError, VolcanoClient
+from volcano_tpu.client import ApiError, APIServer, VolcanoClient
 
 
 def _parse_resource_list(text: str) -> Dict[str, str]:
@@ -637,6 +636,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     fv.add_argument("--spec", "-s", required=True)
 
+    lint = sub.add_parser(
+        "lint",
+        description="run the project-invariant static-analysis suite "
+        "(volcano_tpu.analysis: lock discipline, determinism, jit "
+        "safety, VBUS serde drift); extra arguments are forwarded, "
+        "e.g. `vtctl lint --pass lock --report out.json`",
+    )
+    lint.set_defaults(cmd=None)
+    lint.add_argument("lint_args", nargs=argparse.REMAINDER)
+
     return parser
 
 
@@ -664,6 +673,26 @@ _HANDLERS = {
 
 def main(argv: Optional[List[str]] = None, api: Optional[APIServer] = None, out=None) -> int:
     out = out if out is not None else sys.stdout
+    raw = list(sys.argv[1:] if argv is None else argv)
+    # `lint` is intercepted before argparse: pure source analysis — no
+    # store, no bus — and its flags are forwarded verbatim (argparse
+    # REMAINDER refuses leading optionals).  The scan skips the root
+    # parser's own options so `vtctl --bus X lint …` routes here too
+    # (the bus is simply ignored; lint never touches a store).
+    i = 0
+    while i < len(raw):
+        tok = raw[i]
+        if tok == "--bus":
+            i += 2
+            continue
+        if tok.startswith("--bus="):
+            i += 1
+            continue
+        if tok == "lint":
+            from volcano_tpu.analysis.__main__ import main as lint_main
+
+            return lint_main(raw[i + 1:], out=out)
+        break  # any other first positional/option: normal dispatch
     args = build_parser().parse_args(argv)
     remote = None
     if api is None and getattr(args, "bus", ""):

@@ -21,7 +21,6 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-from volcano_tpu.apis import core
 
 # Watch event types (client-go semantics).
 ADDED = "ADDED"
@@ -56,16 +55,16 @@ class AdmissionError(ApiError):
 class APIServer:
     def __init__(self):
         self._lock = threading.RLock()
-        self._store: Dict[str, Dict[str, object]] = {}
-        self._watchers: Dict[str, List[WatchHandler]] = {}
-        self._admission: Dict[Tuple[str, str], List[AdmissionHook]] = {}
-        self._rv = 0
+        self._store: Dict[str, Dict[str, object]] = {}  # guarded-by: self._lock
+        self._watchers: Dict[str, List[WatchHandler]] = {}  # guarded-by: self._lock
+        self._admission: Dict[Tuple[str, str], List[AdmissionHook]] = {}  # guarded-by: self._lock
+        self._rv = 0  # guarded-by: self._lock
         #: reverse owner index for cascade deletion (the k8s garbage
         #: collector the reference relies on for Job → Pod/PodGroup/
         #: ConfigMap cleanup): (owner kind, ns, owner name) → set of
         #: (child kind, child key).  Entries are validated lazily at
         #: cascade time, so staleness is harmless.
-        self._owned: Dict[Tuple[str, str, str], set] = {}
+        self._owned: Dict[Tuple[str, str, str], set] = {}  # guarded-by: self._lock
 
     # ---- helpers ----
 
@@ -83,16 +82,19 @@ class APIServer:
         return f"{obj.metadata.namespace}/{obj.metadata.name}"
 
     def _bump(self, obj) -> None:
+        # requires-lock: self._lock
         self._rv += 1
         obj.metadata.resource_version = self._rv
         if not obj.metadata.creation_timestamp:
             obj.metadata.creation_timestamp = time.time()
 
     def _notify(self, kind: str, event: str, old, new) -> None:
+        # requires-lock: self._lock
         for handler in self._watchers.get(kind, []):
             handler(event, old, new)
 
     def _run_admission(self, kind: str, operation: str, obj):
+        # requires-lock: self._lock
         for hook in self._admission.get((kind, operation), []):
             obj = hook(operation, obj) or obj
         return obj
@@ -102,7 +104,11 @@ class APIServer:
     def register_admission(self, kind: str, operation: str, hook: AdmissionHook) -> None:
         """operation ∈ {CREATE, UPDATE}; hooks run in registration order,
         mutating first then validating by convention."""
-        self._admission.setdefault((kind, operation), []).append(hook)
+        with self._lock:
+            # registration races request-threads running the admission
+            # chain (the bus server registers webhooks while serving) —
+            # the unlocked setdefault was a lock-discipline lint catch
+            self._admission.setdefault((kind, operation), []).append(hook)
 
     # ---- watch (the informer feed) ----
 
@@ -124,6 +130,7 @@ class APIServer:
     # ---- CRUD ----
 
     def _register_owners(self, obj, key: str) -> None:
+        # requires-lock: self._lock
         for ref in obj.metadata.owner_references:
             if not ref.controller:
                 continue
@@ -131,6 +138,7 @@ class APIServer:
             self._owned.setdefault(parent, set()).add((obj.kind, key))
 
     def _unregister_owners(self, obj, key: str) -> None:
+        # requires-lock: self._lock
         """Prune the reverse index when a child is deleted or its owner
         refs change on update — without this the index grows unbounded
         and keys re-created under a dead owner's name inherit its doom."""

@@ -8,7 +8,7 @@ informer feed and bind/evict/status side effects.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from volcano_tpu.apis import batch, bus, core, scheduling, scheme
 from volcano_tpu.client.apiserver import ADDED, APIServer, DELETED, MODIFIED, NotFoundError

@@ -18,8 +18,8 @@ import os
 import sys
 
 from volcano_tpu.deploy.package import (
-    DEFAULT_VALUES,
     apply_set,
+    DEFAULT_VALUES,
     load_values,
     render,
     render_yaml,

@@ -12,7 +12,7 @@ import argparse
 
 from volcano_tpu.cache import SchedulerCache
 from volcano_tpu.client import APIServer, SchedulerClient
-from volcano_tpu.cmd.daemon import BaseDaemon, apply_faults, serve_forever
+from volcano_tpu.cmd.daemon import apply_faults, BaseDaemon, serve_forever
 from volcano_tpu.scheduler.scheduler import Scheduler
 
 

@@ -25,7 +25,7 @@ The policy is a small YAML document hot-reloaded every scheduling cycle:
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from volcano_tpu.framework.arguments import Arguments
 

@@ -5,7 +5,7 @@ Reference: pkg/controllers/apis/job_info.go.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, Optional
 
 from volcano_tpu.apis import batch, core

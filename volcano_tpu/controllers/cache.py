@@ -16,8 +16,8 @@ from volcano_tpu.controllers.apis import JobInfo
 class JobCache:
     def __init__(self):
         self._lock = threading.RLock()
-        self._jobs: Dict[str, JobInfo] = {}
-        self._deleted: List[str] = []
+        self._jobs: Dict[str, JobInfo] = {}  # guarded-by: self._lock
+        self._deleted: List[str] = []  # guarded-by: self._lock
 
     @staticmethod
     def _job_key(job: batch.Job) -> str:

@@ -9,7 +9,10 @@ from __future__ import annotations
 from typing import List
 
 from volcano_tpu.apis import batch, core
-from volcano_tpu.controllers.job.plugins import PluginInterface, plugin_done_key
+from volcano_tpu.controllers.job.plugins import (
+    plugin_done_key,
+    PluginInterface,
+)
 
 PLUGIN_NAME = "env"
 

@@ -13,7 +13,10 @@ from typing import List
 
 from volcano_tpu.apis import batch, core
 from volcano_tpu.client.apiserver import AlreadyExistsError
-from volcano_tpu.controllers.job.plugins import PluginInterface, plugin_done_key
+from volcano_tpu.controllers.job.plugins import (
+    plugin_done_key,
+    PluginInterface,
+)
 
 PLUGIN_NAME = "ssh"
 

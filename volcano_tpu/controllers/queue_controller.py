@@ -8,10 +8,9 @@ queue_controller_action.go, state/*.go}.
 from __future__ import annotations
 
 import queue as _queue
-from typing import Optional
 
 from volcano_tpu.apis import bus, scheduling
-from volcano_tpu.client import ADDED, APIServer, DELETED, MODIFIED, NotFoundError, VolcanoClient
+from volcano_tpu.client import ADDED, APIServer, MODIFIED, NotFoundError, VolcanoClient
 from volcano_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 CLOSED = "closed"
 OPEN = "open"
@@ -50,11 +50,11 @@ class CircuitBreaker:
         self.failure_threshold = failure_threshold
         self.cooldown_s = cooldown_s
         self._lock = threading.Lock()
-        self._state = CLOSED
-        self._failures = 0
-        self._opened_at = 0.0
-        self._probe_started = 0.0
-        self._last_error = ""
+        self._state = CLOSED  # guarded-by: self._lock
+        self._failures = 0  # guarded-by: self._lock
+        self._opened_at = 0.0  # guarded-by: self._lock
+        self._probe_started = 0.0  # guarded-by: self._lock
+        self._last_error = ""  # guarded-by: self._lock
 
     # ---- state machine ----
 
@@ -104,7 +104,7 @@ class CircuitBreaker:
                 self._opened_at = time.monotonic()
 
     def _transition(self, state: str) -> None:
-        # caller holds the lock
+        # requires-lock: self._lock
         prev, self._state = self._state, state
         if state == OPEN:
             self._failures = 0
@@ -138,7 +138,7 @@ class CircuitBreaker:
             return msg
 
 
-_breakers: Dict[str, CircuitBreaker] = {}
+_breakers: Dict[str, CircuitBreaker] = {}  # guarded-by: _registry_lock
 _registry_lock = threading.Lock()
 
 

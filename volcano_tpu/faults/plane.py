@@ -199,7 +199,7 @@ class FaultPlane:
 
         self.spec = spec
         self._lock = threading.Lock()
-        self._points: Dict[str, _PointState] = {}
+        self._points: Dict[str, _PointState] = {}  # guarded-by: self._lock
         for point in spec.rules:
             # crc32 keeps the per-point seed stable across runs and
             # Python processes (hash() is salted per-process)

@@ -33,8 +33,8 @@ class CycleDeadlineExceeded(RuntimeError):
     """The device phase overran the cycle deadline."""
 
 
-_deadline_s: Optional[float] = None
-_cycle_start: Optional[float] = None
+_deadline_s: Optional[float] = None  # guarded-by: _lock
+_cycle_start: Optional[float] = None  # guarded-by: _lock
 _lock = threading.Lock()
 
 
@@ -56,7 +56,8 @@ def begin_cycle() -> None:
 
 
 def deadline_s() -> Optional[float]:
-    return _deadline_s
+    with _lock:
+        return _deadline_s
 
 
 def remaining_s() -> Optional[float]:

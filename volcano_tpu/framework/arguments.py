@@ -5,7 +5,7 @@ Reference: pkg/scheduler/framework/arguments.go:28-97.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 
 class Arguments(Dict[str, str]):

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import TYPE_CHECKING, List
+from typing import List, TYPE_CHECKING
 
 from volcano_tpu.api import JobInfo
 from volcano_tpu.apis import scheduling

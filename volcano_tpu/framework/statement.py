@@ -10,7 +10,6 @@ from __future__ import annotations
 from typing import List, Tuple, TYPE_CHECKING
 
 from volcano_tpu.api import TaskInfo, TaskStatus
-from volcano_tpu.framework.events import Event
 from volcano_tpu.utils.logging import get_logger
 
 if TYPE_CHECKING:

@@ -48,9 +48,9 @@ class _Histogram:
 class _Registry:
     def __init__(self):
         self._lock = threading.Lock()
-        self._histograms: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], _Histogram] = {}
-        self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = defaultdict(float)
-        self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+        self._histograms: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], _Histogram] = {}  # guarded-by: self._lock
+        self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = defaultdict(float)  # guarded-by: self._lock
+        self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}  # guarded-by: self._lock
 
     def histogram(
         self,

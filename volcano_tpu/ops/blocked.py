@@ -29,19 +29,19 @@ parallel blocks where the TPU is fast.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from volcano_tpu.ops.kernels import (
-    DEFAULT_WEIGHTS,
-    MAX_PRIORITY,
-    ScoreWeights,
     _feasibility_classes,
+    DEFAULT_WEIGHTS,
     f32_lr_exact,
+    MAX_PRIORITY,
     node_scores,
+    ScoreWeights,
     step_delta_ext,
 )
 from volcano_tpu.ops.packing import PackedSnapshot

@@ -24,7 +24,7 @@ async host→device reads can never observe a torn write.
 from __future__ import annotations
 
 import functools
-from typing import Dict, Optional
+from typing import Dict
 
 import numpy as np
 

@@ -22,9 +22,9 @@ import numpy as np
 
 from volcano_tpu.ops.kernels import (
     DEFAULT_WEIGHTS,
-    ScoreWeights,
     f32_lr_exact,
     run_packed,
+    ScoreWeights,
 )
 from volcano_tpu.ops.packing import PackedSnapshot
 

@@ -36,14 +36,14 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from volcano_tpu.api.unschedule_info import (
+    FitErrors,
     NODE_POD_NUMBER_EXCEEDED,
     NODE_RESOURCE_FIT_FAILED,
     NODE_SELECTOR_MISMATCH,
     NODE_TAINT_UNTOLERATED,
     NODE_UNSCHEDULABLE,
-    FitErrors,
 )
-from volcano_tpu.ops.kernels import N_EXPLAIN_REASONS, explain_counts
+from volcano_tpu.ops.kernels import explain_counts, N_EXPLAIN_REASONS
 from volcano_tpu.ops.packing import PackedSnapshot
 
 #: reason strings by kernel plane index (kernels.R_FIT..R_TOL) — the
@@ -298,7 +298,7 @@ def synthesize_no_victim_explanations(ssn, pk) -> int:
 # ---- last-cycle explanation (the /explain debug surface) ----
 
 _last_lock = threading.Lock()
-_last: Optional[Dict[str, Any]] = None
+_last: Optional[Dict[str, Any]] = None  # guarded-by: _last_lock
 
 
 def set_last_explain(info: Optional[Dict[str, Any]]) -> None:

@@ -53,16 +53,16 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from volcano_tpu.ops.packing import (
-    DEFAULT_BIT_WORDS,
-    MIB,
-    BitRegistry,
-    PackedSnapshot,
     _bucket,
     _resource_axis,
     alloc_planes,
+    BitRegistry,
+    DEFAULT_BIT_WORDS,
+    MIB,
     pack_node_row,
     pack_session,
     pack_task_bits,
+    PackedSnapshot,
     resolve_exists_tolerations,
     task_exists_tolerations,
     task_lane_row,
@@ -112,7 +112,7 @@ class PackDelta:
 class PackCache:
     def __init__(self, cache=None, bit_words: int = DEFAULT_BIT_WORDS):
         self.cache = cache
-        self.key = uuid.uuid4().hex[:12]
+        self.key = uuid.uuid4().hex[:12]  # det: session identity, not replay-visible
         self.label_reg = BitRegistry(bit_words)
         self.taint_reg = BitRegistry(bit_words)
         self.rev = 0

@@ -41,11 +41,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from volcano_tpu.ops.kernels import (
+    _feasibility_classes,
     DEFAULT_WEIGHTS,
+    f32_lr_exact,
     MAX_PRIORITY,
     ScoreWeights,
-    _feasibility_classes,
-    f32_lr_exact,
 )
 from volcano_tpu.ops.packing import PackedSnapshot
 

@@ -37,14 +37,14 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass, field
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 
 from volcano_tpu.api import TaskStatus
 from volcano_tpu.apis import scheduling
-from volcano_tpu.ops.kernels import DEFAULT_WEIGHTS, ScoreWeights, node_scores
-from volcano_tpu.ops.packing import PackedSnapshot, pack_session
+from volcano_tpu.ops.kernels import DEFAULT_WEIGHTS, node_scores, ScoreWeights
+from volcano_tpu.ops.packing import pack_session, PackedSnapshot
 
 
 @functools.lru_cache(maxsize=1)

@@ -64,9 +64,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from volcano_tpu.ops.kernels import (
+    _feasibility_classes,
     DEFAULT_WEIGHTS,
     ScoreWeights,
-    _feasibility_classes,
 )
 from volcano_tpu.ops.pallas_session import LANES, score_planes
 from volcano_tpu.ops.preempt_pack import PreemptPacked

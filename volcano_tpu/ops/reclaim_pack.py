@@ -48,7 +48,7 @@ import numpy as np
 
 from volcano_tpu.api import TaskStatus
 from volcano_tpu.apis import scheduling
-from volcano_tpu.ops.packing import PackedSnapshot, _res_vec, pack_session
+from volcano_tpu.ops.packing import _res_vec, pack_session, PackedSnapshot
 from volcano_tpu.ops.preempt_pack import _order_stable
 
 

@@ -45,17 +45,17 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from volcano_tpu.ops.blocked import (
-    INT_BIG,
     _block_scores,
     gang_fixpoint,
+    INT_BIG,
     make_inner_step,
     task_block_padding,
 )
 from volcano_tpu.ops.kernels import (
-    DEFAULT_WEIGHTS,
-    ScoreWeights,
     _feasibility_classes,
+    DEFAULT_WEIGHTS,
     f32_lr_exact,
+    ScoreWeights,
 )
 from volcano_tpu.ops.packing import PackedSnapshot
 

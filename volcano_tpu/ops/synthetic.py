@@ -11,8 +11,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from volcano_tpu.ops.packing import MIB, PackedSnapshot, _bucket
 from volcano_tpu.api.resource import MIN_MEMORY, MIN_MILLI_CPU
+from volcano_tpu.ops.packing import _bucket, MIB, PackedSnapshot
 
 
 def generate_snapshot(

@@ -5,9 +5,9 @@ Reference: pkg/scheduler/plugins/drf/drf.go.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List
 
-from volcano_tpu.api import JobInfo, TaskInfo, Resource
+from volcano_tpu.api import JobInfo, Resource, TaskInfo
 from volcano_tpu.api.resource import empty_resource, share as share_fn
 from volcano_tpu.api.types import allocated_status
 from volcano_tpu.framework.arguments import Arguments

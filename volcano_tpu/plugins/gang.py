@@ -9,12 +9,12 @@ import time
 from typing import List
 
 from volcano_tpu.api import JobInfo, TaskInfo, TaskStatus, ValidateResult
+from volcano_tpu.api.unschedule_info import FitErrors
 from volcano_tpu.apis import scheduling
 from volcano_tpu.framework.arguments import Arguments
 from volcano_tpu.framework.interface import Plugin
 from volcano_tpu.framework.session import Session
 from volcano_tpu.metrics import metrics
-from volcano_tpu.api.unschedule_info import FitErrors
 
 PLUGIN_NAME = "gang"
 

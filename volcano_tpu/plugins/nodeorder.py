@@ -17,7 +17,7 @@ from typing import Dict, List
 
 from volcano_tpu.api import NodeInfo, TaskInfo
 from volcano_tpu.framework.arguments import Arguments
-from volcano_tpu.framework.events import Event, EventHandler
+from volcano_tpu.framework.events import EventHandler
 from volcano_tpu.framework.interface import Plugin
 from volcano_tpu.framework.session import Session
 from volcano_tpu.plugins import util as putil

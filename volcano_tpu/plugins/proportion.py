@@ -5,11 +5,11 @@ Reference: pkg/scheduler/plugins/proportion/proportion.go.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from volcano_tpu.api import JobInfo, QueueInfo, Resource, TaskInfo
 from volcano_tpu.api.resource import empty_resource, min_resource, share as share_fn
-from volcano_tpu.api.types import TaskStatus, allocated_status
+from volcano_tpu.api.types import allocated_status, TaskStatus
 from volcano_tpu.framework.arguments import Arguments
 from volcano_tpu.framework.events import Event, EventHandler
 from volcano_tpu.framework.interface import Plugin

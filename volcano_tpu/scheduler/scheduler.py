@@ -9,15 +9,15 @@ import os
 import time
 from typing import List, Optional
 
-from volcano_tpu import actions as _actions  # registers actions
-from volcano_tpu import plugins as _plugins  # registers plugin builders
+from volcano_tpu import actions as _actions  # noqa: F401 — registers actions
+from volcano_tpu import plugins as _plugins  # noqa: F401 — registers plugin builders
+from volcano_tpu import trace
 from volcano_tpu.cache.interface import Cache
 from volcano_tpu.conf import (
-    SchedulerConf,
     default_scheduler_conf,
     load_scheduler_conf,
+    SchedulerConf,
 )
-from volcano_tpu import trace
 from volcano_tpu.framework import close_session, get_action, open_session
 from volcano_tpu.framework.interface import Action
 from volcano_tpu.metrics import metrics

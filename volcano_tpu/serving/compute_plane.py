@@ -261,7 +261,7 @@ class _SessionStore:
     def __init__(self, max_entries: int = 4):
         self._lock = threading.Lock()
         self._max = max_entries
-        self._entries: "Dict[str, Tuple[int, object]]" = {}
+        self._entries: "Dict[str, Tuple[int, object]]" = {}  # guarded-by: self._lock
 
     def put(self, key: str, rev: int, snap) -> None:
         with self._lock:
@@ -436,8 +436,8 @@ class ComputePlaneClient:
         # pays per bucket shape (cmd/compute_plane.py --warmup avoids it)
         self.socket_path = socket_path
         self.timeout = timeout
-        self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None  # guarded-by: self._lock
+        self._lock = threading.RLock()
         #: session revision the SERVER is known to hold, per cache_key —
         #: a delta frame is only worth sending when the server's copy is
         #: exactly the delta's base revision.  Guarded by _state_lock
@@ -445,8 +445,8 @@ class ComputePlaneClient:
         #: an allocate() the cycle watchdog abandoned (which may
         #: complete AFTER a close cleared the acks) cannot re-insert an
         #: ack the restarted sidecar does not hold.
-        self._acked: Dict[str, int] = {}
-        self._session_gen = 0
+        self._acked: Dict[str, int] = {}  # guarded-by: self._state_lock
+        self._session_gen = 0  # guarded-by: self._state_lock
         self._state_lock = threading.Lock()
         #: set after an "unknown type" error — an old sidecar; stop
         #: attempting delta frames until reconnect
@@ -456,6 +456,7 @@ class ComputePlaneClient:
         self.last_reason_counts: Optional[np.ndarray] = None
 
     def _connect(self) -> socket.socket:
+        # requires-lock: self._lock
         if self._sock is None:
             s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             s.settimeout(self.timeout)
@@ -542,14 +543,19 @@ class ComputePlaneClient:
         return arrays["evicted"].astype(bool), arrays["pipelined"]
 
     def close(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            finally:
-                self._sock = None
-                # the next connection may reach a restarted (upgraded)
-                # sidecar — re-probe delta support
-                self._delta_unsupported = False
+        # _lock is an RLock so the error path inside _roundtrip (which
+        # already holds it) and external callers (the executor's
+        # mark_unhealthy on another thread) both close safely — the
+        # unlocked teardown racing a _roundtrip was a lock lint catch
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+                    # the next connection may reach a restarted
+                    # (upgraded) sidecar — re-probe delta support
+                    self._delta_unsupported = False
         # Session-loss recovery: a closed connection means the next peer
         # may be a RESTARTED sidecar holding no session store.  Forget
         # every acked revision so the re-handshake ships a full frame

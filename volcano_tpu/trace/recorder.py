@@ -133,7 +133,7 @@ class TraceRecorder:
         self.journal = journal
         self.snapshot_every = snapshot_every
         self._epoch = time.perf_counter()
-        self._cycle_id = -1
+        self._cycle_id = -1  # guarded-by: self._lock
         if journal is not None:
             # resume after the journal's newest cycle: recording into a
             # non-empty directory must append, not interleave new cycles
@@ -145,10 +145,10 @@ class TraceRecorder:
             ids = journal.cycles() + journal.snapshot_cycles()
             if ids:
                 self._cycle_id = max(ids)
-        self._cycle_start_us = 0.0
-        self._events: List[Dict[str, Any]] = []
-        self._decisions: List[Dict[str, str]] = []
-        self._dropped = 0
+        self._cycle_start_us = 0.0  # guarded-by: self._lock
+        self._events: List[Dict[str, Any]] = []  # guarded-by: self._lock
+        self._decisions: List[Dict[str, str]] = []  # guarded-by: self._lock
+        self._dropped = 0  # guarded-by: self._lock
         self._last: Optional[Dict[str, Any]] = None
 
     # ---- time base ----
@@ -176,7 +176,7 @@ class TraceRecorder:
                 "cycle": self._cycle_id,
                 "start_us": self._cycle_start_us,
                 "duration_ms": duration_s * 1e3,
-                "wall_time": time.time(),
+                "wall_time": time.time(),  # det: journal timestamp, never replayed
                 "events": self._events,
                 "decisions": self._decisions,
             }
@@ -201,7 +201,8 @@ class TraceRecorder:
 
     @property
     def cycle_id(self) -> int:
-        return self._cycle_id
+        with self._lock:
+            return self._cycle_id
 
     # ---- emission ----
 
@@ -264,11 +265,14 @@ class TraceRecorder:
     # ---- snapshot capture (sampled) ----
 
     def should_capture(self) -> bool:
+        # one locked read of the cycle id — the raw double-read raced
+        # begin_cycle on another thread (lock-discipline lint catch)
+        cid = self.cycle_id
         return (
             self.journal is not None
             and self.snapshot_every > 0
-            and self._cycle_id >= 0
-            and self._cycle_id % self.snapshot_every == 0
+            and cid >= 0
+            and cid % self.snapshot_every == 0
         )
 
     def capture(
@@ -281,9 +285,10 @@ class TraceRecorder:
         computed with, so replay re-runs the exact same configuration."""
         if not self.should_capture():
             return
+        cid = self.cycle_id
         try:
             self.journal.write_snapshot(
-                self._cycle_id, snap, assignment, executor,
+                cid, snap, assignment, executor,
                 weights=weights, gang_rounds=gang_rounds,
             )
         except Exception:  # noqa: BLE001 — deliberate broad guard
@@ -291,7 +296,7 @@ class TraceRecorder:
             # scheduling — this runs inside the allocate action
             logging.getLogger(__name__).warning(
                 "trace snapshot capture failed for cycle %d",
-                self._cycle_id,
+                cid,
                 exc_info=True,
             )
             return
