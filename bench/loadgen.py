@@ -1,0 +1,478 @@
+"""loadgen — the sustained-load SLO harness (ROADMAP item 2).
+
+Batch cycle latency stopped being the user-visible number once the
+relay floor collapsed (BENCH_r05): under sustained churn what a user
+feels is **submit→bind latency** — how long a freshly-created pod waits
+before its binding lands back on the bus.  This harness measures
+exactly that, over the REAL bus topology (TCP BusServer, RemoteAPIServer
+informers, pipelined commit plane, event-driven micro-cycle scheduler):
+
+  * an **open-loop** arrival stream — job arrival times are fixed by
+    the offered rate up front, never gated on the system keeping up, so
+    saturation shows up as growing latency instead of a politely
+    slowed-down generator;
+  * per-pod submit→bind latency observed from store truth (an audit
+    watch on the in-process server, outside the measured path);
+  * p50/p95/p99/max, achieved throughput, the micro-vs-full cycle mix,
+    and the full-cycle fallback causes;
+  * optionally (``--find-saturation``) a rate ramp that reports the
+    highest offered rate whose p99 still meets the SLO.
+
+This is the regression gate for subsequent perf PRs: CI runs
+``--quick`` and uploads the JSON next to the relay-breakdown artifact.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python bench/loadgen.py --quick
+    python bench/loadgen.py --rate 2000 --duration 30 --nodes 1000
+    python bench/loadgen.py --find-saturation --slo-ms 100
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List
+
+import numpy as np
+
+# run from the repo root OR as bench/loadgen.py — same bootstrap the
+# other bench/prof_* scripts use
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+CONF = """
+actions: "enqueue, jax-allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+
+class LoadgenTopology:
+    """The full control loop, every seam real: in-process store behind a
+    TCP BusServer, the scheduler cache fed by RemoteAPIServer informers,
+    binds riding the pipelined commit plane, and the event-driven
+    micro-cycle loop doing the scheduling.  The audit watch runs on the
+    in-process store — store truth, off the measured path."""
+
+    def __init__(self, n_nodes: int, node_cpu: int, conf_path: str,
+                 period: float, debounce_ms: float,
+                 micro_cycles: bool = True):
+        from volcano_tpu.bus.remote import RemoteAPIServer
+        from volcano_tpu.bus.server import BusServer
+        from volcano_tpu.cache import SchedulerCache
+        from volcano_tpu.client import (
+            ADDED,
+            APIServer,
+            KubeClient,
+            MODIFIED,
+            SchedulerClient,
+            VolcanoClient,
+        )
+        from volcano_tpu.scheduler.scheduler import Scheduler
+
+        self.api = APIServer()
+        self.bus = BusServer(self.api).start()
+        self.sched_remote = RemoteAPIServer(
+            f"tcp://127.0.0.1:{self.bus.port}", timeout=10.0
+        )
+        assert self.sched_remote.wait_ready(10.0)
+        # arrivals land on the in-process store (the generator is
+        # colocated with the apiserver, off the measured path) and reach
+        # the SCHEDULER over the real TCP watch stream — the measured
+        # leg.  Submitting over a third TCP connection would serialize
+        # the open-loop generator on round-trips it is not supposed to
+        # be measuring.
+        self.kube = KubeClient(self.api)
+        self.vc = VolcanoClient(self.api)
+
+        self.vc.create_queue(_build_queue("default"))
+        for i in range(n_nodes):
+            self.kube.create_node(
+                _build_node(f"n{i:04d}", {"cpu": str(node_cpu),
+                                          "memory": "256Gi"})
+            )
+
+        #: ns/name → wall-clock the bind landed at store truth
+        self.bind_ts: Dict[str, float] = {}
+        self._bind_lock = threading.Lock()
+
+        def audit(event, old, new):
+            if event not in (ADDED, MODIFIED) or new is None:
+                return
+            if not new.spec.node_name:
+                return
+            key = f"{new.metadata.namespace}/{new.metadata.name}"
+            with self._bind_lock:
+                self.bind_ts.setdefault(key, time.time())
+
+        self.api.watch("Pod", audit, send_initial=False)
+
+        #: completion churn: bound pods finish ``complete_after_s`` after
+        #: their bind and their job objects are deleted — sustained load
+        #: means arrivals AND departures, and without departures the
+        #: resident job count (and with it the O(jobs) session cost of
+        #: every cycle) grows without bound, which is a different
+        #: experiment.  0 disables (short drains / saturation probes).
+        self.complete_after_s = 0.0
+        self._group_size: Dict[str, int] = {}
+        self._reaper_stop = threading.Event()
+        self._reaper = threading.Thread(
+            target=self._reap_loop, name="loadgen-reaper", daemon=True
+        )
+        self._reaper.start()
+
+        self.cache = SchedulerCache(
+            client=SchedulerClient(self.sched_remote),
+            scheduler_name="volcano-tpu",
+            pipelined_commit=True,
+            snapshot_reuse=True,
+        )
+        self.scheduler = Scheduler(
+            self.cache, scheduler_conf_path=conf_path, period=period,
+            micro_cycles=micro_cycles, micro_debounce_ms=debounce_ms,
+        )
+        self._thread = threading.Thread(
+            target=self.scheduler.run, name="loadgen-scheduler", daemon=True
+        )
+        self._thread.start()
+
+    def _reap_loop(self) -> None:
+        from volcano_tpu.client.apiserver import ApiError
+
+        reaped = set()
+        done_per_group: Dict[str, int] = {}
+        while not self._reaper_stop.wait(0.1):
+            if self.complete_after_s <= 0:
+                continue
+            cutoff = time.time() - self.complete_after_s
+            with self._bind_lock:
+                due = [
+                    k for k, ts in self.bind_ts.items()
+                    if ts <= cutoff and k not in reaped
+                ]
+            for key in due:
+                ns, name = key.split("/", 1)
+                group = name.rsplit("-t", 1)[0]
+                try:
+                    self.api.delete("Pod", ns, name)
+                except ApiError:
+                    pass
+                reaped.add(key)
+                done_per_group[group] = done_per_group.get(group, 0) + 1
+                if done_per_group[group] >= self._group_size.get(group, 1):
+                    try:
+                        self.api.delete("PodGroup", ns, group)
+                    except ApiError:
+                        pass
+
+    def submit_job(self, name: str, tasks: int, cpu: str) -> List[str]:
+        """One job: PodGroup + its pods, onto the store.  Returns the
+        pod keys whose binds the audit watch will stamp."""
+        self.vc.create_pod_group(_build_pod_group("ns", name, tasks))
+        self._group_size[name] = tasks
+        keys = []
+        for i in range(tasks):
+            pod_name = f"{name}-t{i}"
+            self.kube.create_pod(
+                _build_pod("ns", pod_name, {"cpu": cpu, "memory": "1Gi"},
+                           group=name)
+            )
+            keys.append(f"ns/{pod_name}")
+        return keys
+
+    def bound_count(self, keys) -> int:
+        with self._bind_lock:
+            return sum(1 for k in keys if k in self.bind_ts)
+
+    def close(self):
+        self._reaper_stop.set()
+        self._reaper.join(timeout=5)
+        self.scheduler.stop()
+        self._thread.join(timeout=15)
+        self.cache.stop_commit_plane()
+        self.sched_remote.close()
+        self.bus.stop()
+
+
+# ---- builders (bench is standalone: no tests/ import) ----
+
+def _build_node(name, alloc):
+    from volcano_tpu.apis import core
+
+    alloc = dict(alloc)
+    alloc.setdefault("pods", 1024)
+    return core.Node(
+        metadata=core.ObjectMeta(name=name, namespace=""),
+        spec=core.NodeSpec(),
+        status=core.NodeStatus(allocatable=alloc, capacity=dict(alloc)),
+    )
+
+
+def _build_pod(namespace, name, req, group):
+    from volcano_tpu.apis import core, scheduling
+
+    return core.Pod(
+        metadata=core.ObjectMeta(
+            name=name, namespace=namespace,
+            annotations={scheduling.GROUP_NAME_ANNOTATION_KEY: group},
+        ),
+        spec=core.PodSpec(
+            containers=[core.Container(
+                name="main", resources={"requests": dict(req)}
+            )],
+        ),
+        status=core.PodStatus(phase="Pending"),
+    )
+
+
+def _build_pod_group(namespace, name, min_member):
+    from volcano_tpu.apis import core, scheduling
+
+    return scheduling.PodGroup(
+        metadata=core.ObjectMeta(name=name, namespace=namespace),
+        spec=scheduling.PodGroupSpec(min_member=min_member, queue="default"),
+        status=scheduling.PodGroupStatus(phase=scheduling.POD_GROUP_INQUEUE),
+    )
+
+
+def _build_queue(name):
+    from volcano_tpu.apis import core, scheduling
+
+    return scheduling.Queue(
+        metadata=core.ObjectMeta(name=name, namespace=""),
+        spec=scheduling.QueueSpec(weight=1),
+    )
+
+
+# ---- the measured phase ----
+
+def run_phase(topo: LoadgenTopology, rate: float, duration: float,
+              tasks_per_job: int, cpu: str, drain_timeout: float,
+              label: str = "run") -> dict:
+    """Open-loop arrivals at ``rate`` jobs/sec for ``duration`` seconds;
+    returns the phase's latency/throughput report."""
+    n_jobs = max(int(rate * duration), 1)
+    interval = 1.0 / rate
+    submit_ts: Dict[str, float] = {}
+    all_keys: List[str] = []
+    late = 0
+
+    start = time.monotonic()
+    wall0 = time.time()
+    for i in range(n_jobs):
+        due = start + i * interval
+        now = time.monotonic()
+        if now < due:
+            time.sleep(due - now)
+        elif now - due > interval:
+            late += 1  # generator fell behind the open-loop schedule
+        # the latency clock starts at the SCHEDULED arrival instant, not
+        # the actual create call — open-loop discipline: if the
+        # generator falls behind, the lag counts as system latency
+        # instead of being silently absorbed (coordinated omission)
+        t_submit = wall0 + (due - start)
+        keys = topo.submit_job(f"{label}-j{i:06d}", tasks_per_job, cpu)
+        for k in keys:
+            submit_ts[k] = t_submit
+        all_keys.extend(keys)
+
+    # drain: every submitted pod must bind (or the run reports the loss)
+    deadline = time.monotonic() + drain_timeout
+    while time.monotonic() < deadline:
+        if topo.bound_count(all_keys) == len(all_keys):
+            break
+        time.sleep(0.05)
+
+    with topo._bind_lock:
+        lat = [
+            (topo.bind_ts[k] - submit_ts[k]) * 1e3
+            for k in all_keys if k in topo.bind_ts
+        ]
+        last_bind = max(
+            (topo.bind_ts[k] for k in all_keys if k in topo.bind_ts),
+            default=wall0,
+        )
+    bound = len(lat)
+    lat_arr = np.asarray(lat) if lat else np.asarray([float("nan")])
+    span = max(last_bind - wall0, 1e-9)
+    return {
+        "offered_rate_jobs_per_s": rate,
+        "jobs": n_jobs,
+        "tasks_per_job": tasks_per_job,
+        "submitted_pods": len(all_keys),
+        "bound_pods": bound,
+        "late_arrivals": late,
+        "p50_ms": round(float(np.percentile(lat_arr, 50)), 3),
+        "p95_ms": round(float(np.percentile(lat_arr, 95)), 3),
+        "p99_ms": round(float(np.percentile(lat_arr, 99)), 3),
+        "max_ms": round(float(lat_arr.max()), 3),
+        "achieved_pods_per_s": round(bound / span, 1),
+    }
+
+
+def _cycle_mix(topo: LoadgenTopology) -> dict:
+    from volcano_tpu.metrics import metrics
+
+    micro = topo.scheduler.micro_cycles_run
+    full = topo.scheduler.full_cycles_run
+    fallbacks = {}
+    with metrics.registry._lock:
+        for (name, labels), v in metrics.registry._counters.items():
+            if name.endswith("full_cycle_fallbacks_total"):
+                fallbacks[dict(labels).get("cause", "?")] = v
+    return {
+        "micro_cycles": micro,
+        "full_cycles": full,
+        "micro_mix": round(micro / max(micro + full, 1), 3),
+        "full_cycle_fallbacks": fallbacks,
+    }
+
+
+def run_loadgen(args) -> dict:
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as f:
+        f.write(CONF)
+        conf_path = f.name
+
+    def fresh_topo():
+        topo = LoadgenTopology(
+            n_nodes=args.nodes, node_cpu=args.node_cpu,
+            conf_path=conf_path, period=args.period,
+            debounce_ms=args.debounce_ms,
+            micro_cycles=not args.no_micro_cycles,
+        )
+        topo.complete_after_s = args.complete_after_s
+        return topo
+
+    def one_run(rate: float, label: str) -> dict:
+        topo = fresh_topo()
+        try:
+            # warmup: prime the jit cache + watch streams off the clock,
+            # so the first measured pod doesn't pay a kernel compile.
+            # Two bursts of different sizes walk the scatter/kernel
+            # shape buckets a churning run will actually hit.
+            deadline = time.monotonic() + args.warmup_timeout
+            for wi, burst in enumerate((4, 24)):
+                warm = topo.submit_job(f"{label}-warm{wi}", burst, args.cpu)
+                while time.monotonic() < deadline:
+                    if topo.bound_count(warm) == len(warm):
+                        break
+                    time.sleep(0.05)
+                if topo.bound_count(warm) != len(warm):
+                    raise RuntimeError("warmup pods never bound")
+            report = run_phase(
+                topo, rate, args.duration, args.tasks_per_job, args.cpu,
+                args.drain_timeout, label=label,
+            )
+            report.update(_cycle_mix(topo))
+            return report
+        finally:
+            topo.close()
+
+    out = {
+        "harness": "loadgen",
+        "config": {
+            "nodes": args.nodes,
+            "node_cpu": args.node_cpu,
+            "duration_s": args.duration,
+            "debounce_ms": args.debounce_ms,
+            "schedule_period_s": args.period,
+            "micro_cycles": not args.no_micro_cycles,
+            "quick": args.quick,
+        },
+    }
+    out["run"] = one_run(args.rate, "run")
+
+    if args.find_saturation:
+        # ramp the offered rate until p99 breaks the SLO (or pods stop
+        # binding); each step runs on a FRESH topology so earlier
+        # backlogs can't poison later steps
+        rate = args.rate
+        best = None
+        steps = []
+        for _ in range(args.saturation_steps):
+            rate = rate * 1.5
+            r = one_run(rate, f"sat{int(rate)}")
+            steps.append(r)
+            ok = (
+                r["bound_pods"] == r["submitted_pods"]
+                and r["p99_ms"] <= args.slo_ms
+            )
+            if not ok:
+                break
+            best = r
+        out["saturation_steps"] = steps
+        out["saturation_throughput_pods_per_s"] = (
+            best["achieved_pods_per_s"] if best is not None
+            else out["run"]["achieved_pods_per_s"]
+        )
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="loadgen", description="sustained-load submit→bind SLO harness"
+    )
+    p.add_argument("--rate", type=float, default=100.0,
+                   help="offered arrival rate, jobs/sec (open-loop)")
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="measured arrival-stream length, seconds")
+    p.add_argument("--tasks-per-job", type=int, default=1)
+    p.add_argument("--cpu", default="100m", help="per-pod cpu request")
+    p.add_argument("--nodes", type=int, default=64)
+    p.add_argument("--node-cpu", type=int, default=64)
+    p.add_argument("--period", type=float, default=1.0,
+                   help="full-cycle re-equilibration period, seconds")
+    p.add_argument("--debounce-ms", type=float, default=5.0)
+    p.add_argument("--no-micro-cycles", action="store_true",
+                   help="baseline: the fixed-period loop (what the SLO "
+                   "numbers look like without event-driven scheduling)")
+    p.add_argument("--complete-after-s", type=float, default=0.75,
+                   help="bound pods complete (pod + podgroup deleted) "
+                   "this long after their bind — sustained churn means "
+                   "departures too, keeping the resident job count (and "
+                   "the O(jobs) session cost) steady.  0 = never")
+    p.add_argument("--drain-timeout", type=float, default=30.0)
+    p.add_argument("--warmup-timeout", type=float, default=120.0)
+    p.add_argument("--find-saturation", action="store_true")
+    p.add_argument("--saturation-steps", type=int, default=4)
+    p.add_argument("--slo-ms", type=float, default=100.0,
+                   help="p99 submit→bind SLO the saturation ramp gates on")
+    p.add_argument("--quick", action="store_true",
+                   help="CI smoke preset: small fleet, short stream")
+    args = p.parse_args(argv)
+
+    if args.quick:
+        args.rate = 25.0
+        args.duration = 4.0
+        args.nodes = 16
+        args.node_cpu = 64
+        args.drain_timeout = 60.0
+
+    report = run_loadgen(args)
+    json.dump(report, sys.stdout, indent=2)
+    sys.stdout.write("\n")
+    # the acceptance gate: every pod bound, and (micro mode) the quick
+    # config meets the p99 SLO
+    r = report["run"]
+    if r["bound_pods"] != r["submitted_pods"]:
+        print(f"LOADGEN FAIL: {r['submitted_pods'] - r['bound_pods']} pods "
+              f"never bound", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

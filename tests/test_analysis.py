@@ -314,11 +314,13 @@ class TestSerdeDrift:
         from volcano_tpu.bus import protocol
 
         grown = dict(protocol.OP_VERSIONS)
-        grown["watch_batch"] = 3
+        # a fictional future op — registered but dispatched nowhere
+        # (watch_batch, the old fixture name here, became a REAL v3 op)
+        grown["evict_batch"] = 4
         monkeypatch.setattr(protocol, "OP_VERSIONS", grown)
         findings = serde_drift.run(find_root())
         assert [f.code for f in findings] == ["SRD004"]
-        assert findings[0].symbol == "watch_batch"
+        assert findings[0].symbol == "evict_batch"
 
 
 # ---- baseline machinery ----

@@ -297,6 +297,7 @@ def test_static_manifest_commands_parse():
                          "--scheduler-conf", "--schedule-period",
                          "--scheduler-name", "--gc-quiesce-period",
                          "--snapshot-reuse", "--warmup",
+                         "--micro-cycles", "--micro-debounce-ms",
                          "--percentage-nodes-to-find",
                          "--minimum-feasible-nodes",
                          "--minimum-percentage-nodes-to-find",
@@ -361,8 +362,10 @@ def test_rendered_scheduler_command_parses():
     parser = argparse.ArgumentParser()
     parser.add_argument("--scheduler-conf", default="")
     parser.add_argument("--schedule-period", type=float, default=1.0)
+    parser.add_argument("--micro-cycles", action="store_true")
     add_common_args(parser)
     args = parser.parse_args(cmd[1:])
+    assert args.micro_cycles is True  # the deployed default is event-driven
     assert args.bus == BUS_URL
     assert args.listen_host == "0.0.0.0"
     assert args.listen_port == 8080

@@ -541,3 +541,124 @@ def test_registry_overflow_recovers_via_cold_rebuild():
     assert not pc.label_reg.overflow
     assert not warm.needs_host_validation
     _assert_identical(warm, cold, "post-overflow rebuild")
+
+
+def test_micro_pack_on_task_bucket_change():
+    """Task-bucket crossings no longer force a cold pack: the micro
+    path rebuilds only the task planes fresh (warm node planes,
+    persistent registries) and stays bit-identical to a seeded cold
+    pack — the subset-pack half of the event-driven micro-cycle
+    (ISSUE 8)."""
+    from volcano_tpu.ops.kernels import run_packed
+
+    rng = np.random.RandomState(11)
+    cache = make_cache(**_base_cluster(rng, n_jobs=8, gang=4))  # 32 pending
+    pc = PackCache(cache)
+    ssn, warm, cold = _pack_both(cache, pc)
+    close_session(ssn)
+    assert pc.last_stats["mode"] == "cold"
+    assert pc.last_stats["cold_cause"] == "first-pack"
+
+    # grow the pending set past the 64-row bucket: + 20 two-task jobs,
+    # some with NEW label pairs (the back-patch coupling must reach the
+    # warm node planes)
+    for k in range(20):
+        cache.add_pod_group(build_pod_group("ns", f"burst{k}", 2, queue="q"))
+        sel = {"disk": "ssd"} if k % 3 == 0 else None
+        for i in range(2):
+            cache.add_pod(
+                build_pod("ns", f"burst{k}-t{i}", "",
+                          {"cpu": "1", "memory": "1Gi"},
+                          group=f"burst{k}", selector=sel)
+            )
+    ssn, micro, cold = _pack_both(cache, pc)
+    _assert_identical(micro, cold, "bucket grow (micro)")
+    assert pc.last_stats["mode"] == "micro"
+    assert micro.task_resreq.shape[0] == 128
+    assert np.array_equal(run_packed(micro), run_packed(cold))
+    close_session(ssn)
+
+    # shrink back under the bucket (delete the burst) — micro again,
+    # and the NEXT unchanged cycle is a plain warm pack over the
+    # micro-produced base
+    burst_pods = [
+        t.pod
+        for j in list(cache.jobs.values())
+        for t in list(j.tasks.values())
+        if t.name.startswith("burst") and t.pod is not None
+    ]
+    for pod in burst_pods:
+        cache.delete_pod(pod)
+    ssn, micro2, cold2 = _pack_both(cache, pc)
+    _assert_identical(micro2, cold2, "bucket shrink (micro)")
+    assert pc.last_stats["mode"] == "micro"
+    assert micro2.task_resreq.shape[0] == 64
+    close_session(ssn)
+
+    ssn, warm2, cold3 = _pack_both(cache, pc)
+    _assert_identical(warm2, cold3, "steady (warm over micro base)")
+    assert pc.last_stats["mode"] == "warm"
+    close_session(ssn)
+
+
+def test_micro_pack_device_stager_consistency():
+    """Staged device planes equal the numpy planes across a micro pack
+    (task planes restaged wholesale at the new bucket, node planes
+    delta-scattered through the padded-bucket scatter)."""
+    import jax.numpy as jnp
+
+    from volcano_tpu.ops.device_stage import get_stager, STAGED_PLANES
+
+    rng = np.random.RandomState(13)
+    cache = make_cache(**_base_cluster(rng, n_jobs=6, gang=4))
+    pc = PackCache(cache)
+    ssn, warm, _cold = _pack_both(cache, pc)
+    stager = get_stager(pc.key)
+    stager.stage(warm)
+    close_session(ssn)
+
+    for k in range(24):
+        cache.add_pod_group(build_pod_group("ns", f"m{k}", 2, queue="q"))
+        for i in range(2):
+            cache.add_pod(
+                build_pod("ns", f"m{k}-t{i}", "",
+                          {"cpu": "1", "memory": "1Gi"}, group=f"m{k}")
+            )
+    ssn, micro, _cold = _pack_both(cache, pc)
+    assert pc.last_stats["mode"] == "micro"
+    planes = stager.stage(micro)
+    for name in STAGED_PLANES:
+        arr = getattr(micro, name)
+        if arr is None:
+            continue
+        assert np.array_equal(np.asarray(planes[name]), arr), (
+            f"staged plane {name} diverged after micro pack"
+        )
+    close_session(ssn)
+
+
+def test_cold_cause_recorded():
+    """PackCache.last_stats names why a pack went cold — the label the
+    micro-cycle fallback counter attributes."""
+    rng = np.random.RandomState(17)
+    cache = make_cache(**_base_cluster(rng, n_jobs=4, gang=3, n_nodes=6))
+    pc = PackCache(cache)
+    ssn, _, _ = _pack_both(cache, pc)
+    close_session(ssn)
+    assert pc.last_stats["cold_cause"] == "first-pack"
+
+    # registry overflow → cold with the overflow cause
+    pc.label_reg.overflow = True
+    ssn, warm, cold = _pack_both(cache, pc)
+    _assert_identical(warm, cold, "overflow recovery")
+    assert pc.last_stats["mode"] == "cold"
+    assert pc.last_stats["cold_cause"] == "registry-overflow"
+    close_session(ssn)
+
+    # node topology change → cold with the topology cause
+    cache.add_node(build_node("fresh-node", {"cpu": "8", "memory": "16Gi"}))
+    ssn, warm, cold = _pack_both(cache, pc)
+    _assert_identical(warm, cold, "topology rebuild")
+    assert pc.last_stats["mode"] == "cold"
+    assert pc.last_stats["cold_cause"] == "topology"
+    close_session(ssn)

@@ -252,6 +252,14 @@ class JaxAllocateAction(Action):
                 enforce_pod_count=enforce,
             )
             last_phase_stats.update(pack_cache.last_stats)
+            if getattr(ssn.cache, "in_micro_cycle", False):
+                # a micro-triggered cycle that still had to cold-rebuild
+                # (registry overflow, axis change, …) paid full-cycle
+                # cost — attribute the cause so the SLO harness can see
+                # why the incremental path was unsound
+                cause = pack_cache.last_stats.get("cold_cause")
+                if cause is not None:
+                    metrics.register_full_cycle_fallback(cause)
         else:
             snap = pack_session(
                 ordered_tasks,

@@ -44,13 +44,19 @@ from volcano_tpu.client.apiserver import (
 MAGIC = b"VBUS"
 #: v2 adds the coalesced ``commit_batch`` request op (one frame carrying
 #: N binds + evictions + audit events + status writebacks, applied as a
-#: single store transaction).  The frame LAYOUT is unchanged, so frames
-#: are STAMPED with MIN_VERSION — a v1 peer accepts every frame at the
-#: framing layer, and a v2 client talking to a v1 server detects the
-#: unknown ``commit_batch`` op from the typed error and falls back to
-#: per-object binds (bus/remote.py).  VERSION is the protocol revision
-#: this build speaks; receivers accept [MIN_VERSION, VERSION].
-VERSION = 2
+#: single store transaction).  v3 adds the ``watch_batch`` op: a watch
+#: established through it may receive coalesced ``T_WATCH_BATCH``
+#: frames (N watch events in one frame, batched on the server's writer
+#: thread) instead of one ``T_WATCH_EVENT`` frame per object — the
+#: README known-gap on watch fan-out under commit_batch bursts.  The
+#: frame LAYOUT is unchanged throughout, so frames are STAMPED with
+#: MIN_VERSION — a v1 peer accepts every frame at the framing layer,
+#: and a newer client talking to an older server detects the unknown
+#: op from the typed error and falls back (per-object binds for
+#: ``commit_batch``; a plain ``watch`` for ``watch_batch`` — bus/
+#: remote.py).  VERSION is the protocol revision this build speaks;
+#: receivers accept [MIN_VERSION, VERSION].
+VERSION = 3
 #: oldest frame version this build still decodes — and the version
 #: outgoing frames carry, since the layout has not changed since v1
 MIN_VERSION = 1
@@ -66,6 +72,13 @@ T_PING = 7
 T_PONG = 8
 T_ADMIT_REQ = 9      # server → client: admission review request
 T_ADMIT_RESP = 10    # client → server: admission review verdict
+#: server → client: N coalesced watch events in one frame.  Payload is
+#: ``{"events": [{"watch_id": w, ...entry}, ...]}`` — each entry is
+#: exactly a T_WATCH_EVENT payload plus the watch id it belongs to (one
+#: connection multiplexes many watches, and the correlation-id slot can
+#: carry only one).  Sent ONLY on watches established via the
+#: ``watch_batch`` op, so a v1/v2 peer never sees the type.
+T_WATCH_BATCH = 11
 
 _HEADER = struct.Struct("<4sHHII")
 
@@ -101,6 +114,7 @@ OP_VERSIONS: Dict[str, int] = {
     "unwatch": 1,
     "register_admission": 1,
     "commit_batch": 2,
+    "watch_batch": 3,
 }
 
 #: wire error name → exception class; unknown names fall back to ApiError

@@ -129,6 +129,10 @@ class RemoteAPIServer:
         #: set once a server rejects the v2 ``commit_batch`` op — the
         #: old-peer fallback (per-object binds) for skewed apiservers
         self._no_commit_batch = False
+        #: set once a server rejects the v3 ``watch_batch`` op — watches
+        #: then (re-)establish via plain ``watch`` and receive one
+        #: T_WATCH_EVENT frame per object, exactly the old behavior
+        self._no_watch_batch = False
 
         self._ctl: "queue.Queue[tuple]" = queue.Queue()
         self._dispatch_q: "queue.Queue[Optional[tuple]]" = queue.Queue()
@@ -268,6 +272,15 @@ class RemoteAPIServer:
                 state = self._watch_state(corr_id)
                 if state is not None:
                     self._dispatch_q.put(("event", state, payload))
+            elif mtype == protocol.T_WATCH_BATCH:
+                # coalesced frame (protocol v3): unbatch in wire order —
+                # each entry carries its own watch id, and the dispatch
+                # queue preserves ordering exactly as per-object frames
+                # would have
+                for entry in payload.get("events", ()):
+                    state = self._watch_state(int(entry.get("watch_id", -1)))
+                    if state is not None:
+                        self._dispatch_q.put(("event", state, entry))
             elif mtype == protocol.T_BOOKMARK:
                 state = self._watch_state(corr_id)
                 if state is not None:
@@ -561,20 +574,41 @@ class RemoteAPIServer:
                         ("reconcile", state, (resp["initial"], resp["seq"]))
                     )
 
-        payload = {"op": "watch", "kind": state.kind,
-                   "watch_id": state.watch_id}
+        def establish(base: dict) -> dict:
+            """One watch request, preferring the v3 coalesced-delivery
+            op.  A server that answers ``unknown bus op`` for
+            ``watch_batch`` is an old peer — degrade PERMANENTLY (per
+            connection lifetime) to the per-object ``watch`` op; skew
+            costs fan-out throughput, never correctness."""
+            if not self._no_watch_batch:
+                try:
+                    return self._call(
+                        {"op": "watch_batch", **base}, on_reply=accept
+                    )
+                except BusError:
+                    raise  # transport failure — NOT a capability signal
+                except ApiError as e:
+                    if "unknown bus op" not in str(e):
+                        raise
+                    log.warning(
+                        "bus %s does not speak watch_batch (old peer); "
+                        "per-object watch frames", self.address,
+                    )
+                    self._no_watch_batch = True
+            return self._call({"op": "watch", **base}, on_reply=accept)
+
+        base = {"kind": state.kind, "watch_id": state.watch_id}
         if state.epoch is not None and state.last_seq is not None:
-            payload["epoch"] = state.epoch
-            payload["resume_seq"] = state.last_seq
-        resp = self._call(payload, on_reply=accept)
+            base["epoch"] = state.epoch
+            base["resume_seq"] = state.last_seq
+        resp = establish(base)
         if not resp.get("resumed"):
             # 410 Gone — relist: fresh watch returns an atomic snapshot
             # the dispatch thread reconciles against the shadow cache
             metrics.register_bus_relist(state.kind)
             log.info("bus watch %s: resume rejected (410); relisting",
                      state.kind)
-            self._call({"op": "watch", "kind": state.kind,
-                        "watch_id": state.watch_id}, on_reply=accept)
+            establish({"kind": state.kind, "watch_id": state.watch_id})
 
     def _dispatch_loop(self) -> None:
         while True:

@@ -51,6 +51,10 @@ log = get_logger(__name__)
 #: too slow and disconnected (it will resync via resume-or-relist)
 _OUTBOUND_DEPTH = 16384
 
+#: watch events coalesced into one T_WATCH_BATCH frame at most — bounds
+#: frame size so a relist-scale replay cannot produce one giant payload
+_WATCH_BATCH_MAX = 512
+
 
 class _Conn:
     """One accepted connection: a reader (request handler) thread plus a
@@ -64,6 +68,12 @@ class _Conn:
             maxsize=_OUTBOUND_DEPTH
         )
         self.closed = False
+        #: the peer established its watches via the v3 ``watch_batch``
+        #: op: consecutive T_WATCH_EVENT frames may coalesce into one
+        #: T_WATCH_BATCH frame on the writer thread below.  Set before
+        #: the first watch response is pushed, read only by the writer —
+        #: a plain flag, no lock needed.
+        self.batch_watch = False
         #: watch_id → kind, for cleanup on close
         self.watches: Dict[int, str] = {}
         #: review_id → waiter, resolved by T_ADMIT_RESP frames
@@ -106,24 +116,69 @@ class _Conn:
             waiter["event"].set()
         self.reviews.clear()
 
-    def write_loop(self) -> None:
+    def _send(self, mtype: int, corr_id: int, payload: dict) -> bool:
+        """Send one wire frame (with the bus.delay injection point);
+        False kills the connection."""
         from volcano_tpu import faults
 
+        fp = faults.get_plane()
+        if fp.enabled and fp.should("bus.delay"):
+            # latency injection lives on the writer thread, NOT the
+            # store-side notifier — a slow wire must never stall the
+            # store (the decoupling this queue exists for)
+            time.sleep(fp.param_ms("bus.delay") / 1e3)
+        try:
+            protocol.send_frame(self.sock, mtype, corr_id, payload)
+            return True
+        except (OSError, ValueError):
+            self.kill()
+            return False
+
+    def write_loop(self) -> None:
         while True:
             item = self.outbound.get()
             if item is None or self.closed:
                 return
             mtype, corr_id, payload = item
-            fp = faults.get_plane()
-            if fp.enabled and fp.should("bus.delay"):
-                # latency injection lives on the writer thread, NOT the
-                # store-side notifier — a slow wire must never stall the
-                # store (the decoupling this queue exists for)
-                time.sleep(fp.param_ms("bus.delay") / 1e3)
-            try:
-                protocol.send_frame(self.sock, mtype, corr_id, payload)
-            except (OSError, ValueError):
-                self.kill()
+            if not (self.batch_watch and mtype == protocol.T_WATCH_EVENT):
+                if not self._send(mtype, corr_id, payload):
+                    return
+                continue
+            # watch-frame coalescing (protocol v3): a commit_batch
+            # transaction lands N notifications on this queue in one
+            # burst before this thread wakes — drain the consecutive
+            # watch events greedily and ship ONE T_WATCH_BATCH frame.
+            # Each entry carries its watch id (the correlation-id slot
+            # holds only one); entry dicts are shared with the server
+            # backlog and other connections, so copy-extend, never
+            # mutate.  A non-watch frame (response, bookmark, admission
+            # review) is an ordering barrier: it flushes the batch and
+            # is sent right after, in queue order.
+            batch = [dict(payload, watch_id=corr_id)]
+            tail = None
+            drained_stop = False
+            while len(batch) < _WATCH_BATCH_MAX:
+                try:
+                    nxt = self.outbound.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    drained_stop = True
+                    break
+                if nxt[0] != protocol.T_WATCH_EVENT:
+                    tail = nxt
+                    break
+                batch.append(dict(nxt[2], watch_id=nxt[1]))
+            if len(batch) == 1:
+                ok = self._send(mtype, corr_id, payload)
+            else:
+                metrics.observe_watch_batch(len(batch))
+                ok = self._send(protocol.T_WATCH_BATCH, 0, {"events": batch})
+            if not ok:
+                return
+            if tail is not None and not self._send(*tail):
+                return
+            if drained_stop or self.closed:
                 return
 
 
@@ -451,6 +506,14 @@ class BusServer:
         if op == "watch":
             self._handle_watch(conn, req_id, payload)
             return None  # responses pushed inline for ordering
+        if op == "watch_batch":
+            # v3: identical watch semantics, but the connection opts into
+            # coalesced T_WATCH_BATCH delivery (the writer thread batches
+            # consecutive watch frames).  Flag first: the flip must be
+            # visible before the establishment pushes any event.
+            conn.batch_watch = True
+            self._handle_watch(conn, req_id, payload)
+            return None
         if op == "unwatch":
             watch_id = int(payload["watch_id"])
             with self.api.locked():

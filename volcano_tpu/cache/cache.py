@@ -205,6 +205,18 @@ class SchedulerCache(Cache):
         self._resync_inflight: set = set()  # guarded-by: self._mutex
         #: one-shot flag for the "client can't record events" warning
         self._warned_no_events = False
+        #: change listeners for the event-driven scheduler loop: each is
+        #: called with a coarse category string AFTER the mutating
+        #: handler releases the mutex (so a listener that takes its own
+        #: lock — the scheduler's wake condition — never nests inside
+        #: the cache mutex).  Categories: "task" (schedulable work
+        #: appeared/changed), "node" (capacity moved: pod finished/
+        #: deleted, node object updated), "topology" (node set changed),
+        #: "gang" (a PodGroup with min_member > 1 arrived), "group"
+        #: (other scheduling-relevant object churn).  Bind echoes of our
+        #: own placements are deliberately NOT emitted — they would
+        #: wake the loop once per bind for cycles with nothing to do.
+        self._change_listeners: List = []  # guarded-by: self._mutex
         #: job uid → latest unschedulable writeback digest.  Fit errors
         #: live on session clones (JobInfo.clone resets them), so the
         #: status writeback below is the one durable point that sees
@@ -246,6 +258,9 @@ class SchedulerCache(Cache):
         self._pool_jobs: Dict[str, JobInfo] = {}
         self._pool_rev = -1
         self._pool_open = False
+
+        #: informer registration latch (run() is idempotent)
+        self._watch_started = False
 
         # The reference fires bind/evict in goroutines (cache.go:596-612).
         # sync_side_effects=True (default) keeps them on-thread for
@@ -293,8 +308,16 @@ class SchedulerCache(Cache):
     def run(self) -> None:
         if not self._sync and self._pool is None:
             self._pool = ThreadPoolExecutor(max_workers=8)
-        if self.client is not None:
+        # idempotent: Scheduler.run() calls this unconditionally, and a
+        # harness may already have started the informers — registering
+        # the watch handlers twice would deliver every event twice.
+        # The latch is set AFTER watch() returns: a registration that
+        # raised mid-way (transient bus outage at startup) stays
+        # retryable on the next run() instead of poisoning the latch
+        # and leaving a silent informer-less scheduler.
+        if self.client is not None and not self._watch_started:
             self.client.watch(self)
+            self._watch_started = True
 
     def wait_for_cache_sync(self) -> bool:
         return True
@@ -325,6 +348,72 @@ class SchedulerCache(Cache):
             fn(*args)
         else:
             self._pending.append(self._pool.submit(fn, *args))
+
+    # ---- change notification (the event-driven scheduler's wake) ----
+
+    def add_change_listener(self, fn) -> None:
+        """Register ``fn(category: str)`` to be called after every
+        scheduling-relevant cache mutation (watch events and resyncs —
+        never our own bind/evict accounting, which would be a feedback
+        loop).  Listeners run outside the cache mutex, on the thread
+        that delivered the event; they must be cheap and non-blocking
+        (the scheduler's listener just flips a condition variable)."""
+        with self._mutex:
+            if fn not in self._change_listeners:
+                self._change_listeners.append(fn)
+
+    def remove_change_listener(self, fn) -> None:
+        with self._mutex:
+            if fn in self._change_listeners:
+                self._change_listeners.remove(fn)
+
+    def _emit_change(self, category: Optional[str]) -> None:
+        """Fan a change category out to the listeners.  Called OUTSIDE
+        the mutex by the public event handlers; ``None`` (a suppressed
+        bind-echo) is a no-op."""
+        if category is None:
+            return
+        with self._mutex:
+            listeners = list(self._change_listeners)
+        for fn in listeners:
+            try:
+                fn(category)
+            except Exception as e:  # noqa: BLE001 — a bad listener must
+                # not break informer delivery
+                log.error("cache change listener failed: %s", e)
+
+    def has_schedulable_pending(self) -> bool:
+        """Is there any pending task a scheduling cycle could act on?
+        The event-driven loop consults this before spending a session on
+        a capacity-freed wake ("node"/"group" triggers): under churn,
+        every completion fires one — running a full session per
+        departure with nothing pending would double the cycle load for
+        zero bindings."""
+        with self._mutex:
+            for job in self.jobs.values():
+                if job.pod_group is None:
+                    continue
+                if job.task_status_index.get(TaskStatus.Pending):
+                    return True
+            return False
+
+    @staticmethod
+    def _classify_pod_update(old_ti: TaskInfo, new_ti: TaskInfo,
+                             spec_changed: bool) -> Optional[str]:
+        """Wake category for a pod MODIFIED event — or None for churn a
+        scheduling cycle cannot act on (the common case in steady
+        state: our own bind's watch echo and the kubelet's
+        Pending→Running flip, which would otherwise wake the loop once
+        per placement)."""
+        if spec_changed:
+            return "task"
+        if is_terminated(new_ti.status) and not is_terminated(old_ti.status):
+            return "node"  # capacity freed — stuck tasks may now fit
+        if not old_ti.node_name and new_ti.node_name:
+            return None  # bind echo of a placement this loop made
+        if old_ti.status != new_ti.status and new_ti.status == TaskStatus.Pending:
+            return "task"  # task returned to schedulable
+        return None
 
     # ---- warm-cycle change tracking ----
 
@@ -454,6 +543,13 @@ class SchedulerCache(Cache):
             self._mark_task(ti.uid)
             self._clear_quarantine(ti.uid)
             self._add_task(ti)
+        # a freshly-submitted schedulable pod is THE micro-cycle trigger;
+        # a pre-bound or terminated pod only moves accounting
+        self._emit_change(
+            "task"
+            if not ti.node_name and ti.status == TaskStatus.Pending
+            else None
+        )
 
     def update_pod(self, old_pod: core.Pod, new_pod: core.Pod) -> None:
         with self._mutex:
@@ -462,11 +558,15 @@ class SchedulerCache(Cache):
             # status/node churn re-derives job/node accounting (marked by
             # _delete/_add below) but keeps the packed task row clean —
             # only spec-level changes invalidate it
-            if _task_pack_relevant_changed(old_pod, new_pod):
+            spec_changed = _task_pack_relevant_changed(old_pod, new_pod)
+            if spec_changed:
                 self._mark_task(new_ti.uid)
             self._clear_quarantine(new_ti.uid)
             self._delete_task(old_ti)
             self._add_task(new_ti)
+        self._emit_change(
+            self._classify_pod_update(old_ti, new_ti, spec_changed)
+        )
 
     def delete_pod(self, pod: core.Pod) -> None:
         with self._mutex:
@@ -474,6 +574,9 @@ class SchedulerCache(Cache):
             self._mark_task(ti.uid)
             self._clear_quarantine(ti.uid)
             self._delete_task(ti)
+        # a deleted bound pod frees capacity stuck tasks may want; a
+        # deleted pending pod just removes work
+        self._emit_change("node" if ti.node_name else None)
 
     # ---- event handlers: nodes (event_handlers.go:255-354) ----
 
@@ -483,10 +586,13 @@ class SchedulerCache(Cache):
             if name in self.nodes:
                 self.nodes[name].set_node(node)
                 self._mark_node_full(name)
+                fresh = False
             else:
                 self.nodes[name] = NodeInfo(node)
                 self._mark_topology()
                 self._mark_node_full(name)
+                fresh = True
+        self._emit_change("topology" if fresh else "node")
 
     def update_node(self, old_node: core.Node, new_node: core.Node) -> None:
         with self._mutex:
@@ -494,24 +600,30 @@ class SchedulerCache(Cache):
             if name in self.nodes:
                 self.nodes[name].set_node(new_node)
                 self._mark_node_full(name)
+                fresh = False
             else:
                 self.nodes[name] = NodeInfo(new_node)
                 self._mark_topology()
                 self._mark_node_full(name)
+                fresh = True
+        self._emit_change("topology" if fresh else "node")
 
     def delete_node(self, node: core.Node) -> None:
         with self._mutex:
-            if self.nodes.pop(node.metadata.name, None) is not None:
+            popped = self.nodes.pop(node.metadata.name, None) is not None
+            if popped:
                 self._mark_topology()
                 self._mark_node_full(node.metadata.name)
                 # mutation stamps only matter for LIVE objects (absent
                 # entry = never reusable) — drop so the dict tracks the
                 # live node set, not historical churn
                 self._node_mut_rev.pop(node.metadata.name, None)
+        if popped:
+            self._emit_change("topology")
 
     # ---- event handlers: podgroups (event_handlers.go:356-581) ----
 
-    def add_pod_group(self, pg: scheduling.PodGroup) -> None:
+    def _set_pod_group(self, pg: scheduling.PodGroup) -> None:
         with self._mutex:
             job_id = pg.key()
             if job_id not in self.jobs:
@@ -519,8 +631,24 @@ class SchedulerCache(Cache):
             self.jobs[job_id].set_pod_group(pg)
             self._mark_job(job_id)
 
+    def add_pod_group(self, pg: scheduling.PodGroup) -> None:
+        self._set_pod_group(pg)
+        # a gang group's members arrive as an event storm right behind
+        # it — route the whole arrival to a full cycle (the gang/fair-
+        # share re-equilibration path) instead of micro-scheduling a
+        # half-arrived gang
+        self._emit_change(
+            "gang" if (pg.spec.min_member or 0) > 1 else "group"
+        )
+
     def update_pod_group(self, old_pg, new_pg: scheduling.PodGroup) -> None:
-        self.add_pod_group(new_pg)
+        self._set_pod_group(new_pg)
+        # the overwhelmingly common MODIFIED is our own status writeback
+        # echoing back through the watch — only a SPEC change is
+        # scheduling-relevant
+        self._emit_change(
+            "group" if old_pg is None or old_pg.spec != new_pg.spec else None
+        )
 
     def delete_pod_group(self, pg: scheduling.PodGroup) -> None:
         with self._mutex:
@@ -534,6 +662,7 @@ class SchedulerCache(Cache):
                     del self.jobs[pg.key()]
                     self._job_mut_rev.pop(pg.key(), None)
                     self.unschedulable_digest.pop(pg.key(), None)
+        self._emit_change("group")
 
     # ---- dual-version handlers (cache.go:393-424: the v1alpha1
     # informer set converts BOTH old and new through the scheme, then
@@ -569,13 +698,24 @@ class SchedulerCache(Cache):
         with self._mutex:
             qi = QueueInfo(queue)
             self.queues[qi.uid] = qi
+        self._emit_change("group")
 
     def update_queue(self, old_queue, new_queue: scheduling.Queue) -> None:
-        self.add_queue(new_queue)
+        with self._mutex:
+            qi = QueueInfo(new_queue)
+            self.queues[qi.uid] = qi
+        # status writebacks echo through the watch every cycle — only a
+        # spec change (weight/capability) is scheduling-relevant
+        self._emit_change(
+            "group"
+            if old_queue is None or old_queue.spec != new_queue.spec
+            else None
+        )
 
     def delete_queue(self, queue: scheduling.Queue) -> None:
         with self._mutex:
             self.queues.pop(queue.metadata.name, None)
+        self._emit_change("group")
 
     # ---- event handlers: priority classes (event_handlers.go:865-958) ----
 
@@ -584,25 +724,47 @@ class SchedulerCache(Cache):
             self.priority_classes[pc.metadata.name] = pc
             if pc.global_default:
                 self.default_priority = pc.value
+        self._emit_change("group")
 
     def delete_priority_class(self, pc: core.PriorityClass) -> None:
         with self._mutex:
             self.priority_classes.pop(pc.metadata.name, None)
             if pc.global_default:
                 self.default_priority = 0
+        self._emit_change("group")
 
     # ---- PVC handlers (pvcInformer wiring, cache.go:415-421) ----
 
-    def add_pvc(self, pvc: core.PersistentVolumeClaim) -> None:
+    def _put_pvc(self, pvc: core.PersistentVolumeClaim) -> None:
         with self._mutex:
             self.pvcs[f"{pvc.metadata.namespace}/{pvc.metadata.name}"] = pvc
 
+    def add_pvc(self, pvc: core.PersistentVolumeClaim) -> None:
+        self._put_pvc(pvc)
+        self._emit_change("group")
+
     def update_pvc(self, old, new: core.PersistentVolumeClaim) -> None:
+        # echo suppression: bind_volumes already parked our own
+        # provisioning write via _put_pvc, so when the watch echoes it
+        # back the cached object matches the incoming one (modulo the
+        # store's resourceVersion bump) — such an update carries no new
+        # scheduling information and must not wake the event loop (the
+        # same wake-per-placement feedback bind echoes are filtered for)
+        with self._mutex:
+            key = f"{new.metadata.namespace}/{new.metadata.name}"
+            cached = self.pvcs.get(key)
+        if cached is not None:
+            a, b = cached.clone(), new.clone()
+            a.metadata.resource_version = b.metadata.resource_version = 0
+            if a == b:
+                self._put_pvc(new)  # keep the fresher resourceVersion
+                return
         self.add_pvc(new)
 
     def delete_pvc(self, pvc: core.PersistentVolumeClaim) -> None:
         with self._mutex:
             self.pvcs.pop(f"{pvc.metadata.namespace}/{pvc.metadata.name}", None)
+        self._emit_change("group")
 
     # ---- event handlers: resource quotas (event_handlers.go:961-1036) ----
 
@@ -612,12 +774,14 @@ class SchedulerCache(Cache):
                 namespace, NamespaceCollection(namespace)
             )
             coll.update(quota_name, weight)
+        self._emit_change("group")
 
     def delete_resource_quota(self, namespace: str, quota_name: str) -> None:
         with self._mutex:
             coll = self.namespace_collections.get(namespace)
             if coll is not None:
                 coll.delete(quota_name)
+        self._emit_change("group")
 
     # ---- snapshot (cache.go:712-790) ----
 
@@ -882,6 +1046,8 @@ class SchedulerCache(Cache):
             for (t, h), err in zip(ok, results):
                 if err is not None:
                     self._fail_bind_item(t, h, RuntimeError(err))
+                else:
+                    self._observe_bind_latency(t)
             return
         for task, hostname in ok:
             try:
@@ -890,12 +1056,31 @@ class SchedulerCache(Cache):
             except Exception as e:  # noqa: BLE001
                 self._fail_bind_item(task, hostname, e)
             else:
+                self._observe_bind_latency(task)
                 # cache.go:600-610 — the Scheduled audit event
                 self._record_event(
                     task, "Normal", "Scheduled",
                     f"Successfully assigned {task.namespace}/{task.name}"
                     f" to {hostname}",
                 )
+
+    @staticmethod
+    def _observe_bind_latency(task: TaskInfo) -> None:
+        """volcano_submit_to_bind_latency_milliseconds: store creation
+        timestamp → bind effect landed — the sustained-load SLO number,
+        recorded here so the synchronous and pipelined paths share the
+        one landing site.  Synthetic fixtures carry small ordinal
+        timestamps, not epochs — only a plausible wall-clock stamp is
+        observed (everything else would land in +Inf and poison the
+        percentiles)."""
+        import time as _time
+
+        pod = task.pod
+        ts = pod.metadata.creation_timestamp if pod is not None else 0
+        if ts and ts > 1e9:  # epoch seconds, not an ordinal fixture stamp
+            from volcano_tpu.metrics import metrics
+
+            metrics.observe_submit_to_bind(max(_time.time() - ts, 0.0))
 
     def _fail_bind_item(self, task, hostname, e) -> None:
         from volcano_tpu.metrics import metrics
@@ -1058,7 +1243,10 @@ class SchedulerCache(Cache):
             pvc.status["phase"] = "Bound"
             if self.client is not None and hasattr(self.client, "update_pvc"):
                 self.client.update_pvc(pvc)
-            self.add_pvc(pvc)
+            # _put_pvc, not add_pvc: our own provisioning write must not
+            # wake the event loop (the watch echo is suppressed the same
+            # way bind echoes are)
+            self._put_pvc(pvc)
         task.volume_ready = True
 
     #: resync retry bound + backoff (cache.go:687-709 errTasks uses a
@@ -1159,6 +1347,9 @@ class SchedulerCache(Cache):
             self._delete_task(task)
             if pod is not None:
                 self._add_task(new_task_info(pod))
+        # a resynced task is schedulable work again (the failed bind was
+        # unwound against API truth) — wake the event loop for it
+        self._emit_change("task" if pod is not None else None)
 
     def process_due_resyncs(self) -> None:
         """Drain every due resync entry (called once per scheduling

@@ -48,6 +48,27 @@ class Cache(abc.ABC):
     def bind_volumes(self, task: TaskInfo) -> None:
         """interface.go:54."""
 
+    # ---- event-driven scheduling surface (optional; this build) ----
+    # Defaults are no-ops so any Cache implementation composes with the
+    # wake-on-event loop: a cache that never notifies simply leaves the
+    # scheduler purely periodic.
+
+    def add_change_listener(self, fn) -> None:
+        """Register ``fn(category: str)`` to fire after scheduling-
+        relevant cache mutations (watch events/resyncs, never the
+        scheduler's own bind/evict accounting).  Categories:
+        task / node / topology / gang / group.  Listeners must be cheap
+        and non-blocking; they run on the event-delivery thread."""
+
+    def remove_change_listener(self, fn) -> None: ...
+
+    def has_schedulable_pending(self) -> bool:
+        """Is there pending work a scheduling cycle could act on?  The
+        event loop consults this before spending a session on a
+        capacity-freed wake; True (the conservative default) means
+        "always run the cycle"."""
+        return True
+
 
 class Binder(abc.ABC):
     """interface.go:60-63."""

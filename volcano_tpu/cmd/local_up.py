@@ -64,7 +64,8 @@ def local_up(nodes: int = 3, node_cpu: str = "8", node_mem: str = "16Gi",
              gate_pods: bool = False, scheduler_conf: str = "",
              listen_host: str = "127.0.0.1",
              admission_port: int = 0, controllers_port: int = 0,
-             scheduler_port: int = 0, api=None):
+             scheduler_port: int = 0, api=None,
+             micro_cycles: bool = False):
     """Start the full control plane; returns (api, [daemons]).
 
     Ports default to 0 (ephemeral) for tests/interactive use; a real
@@ -86,6 +87,7 @@ def local_up(nodes: int = 3, node_cpu: str = "8", node_mem: str = "16Gi",
     scheduler = SchedulerDaemon(
         api, schedule_period=0.2, scheduler_conf=scheduler_conf,
         listen_host=listen_host, listen_port=scheduler_port,
+        micro_cycles=micro_cycles,
     ).start()
     return api, [admission, controllers, scheduler]
 
@@ -151,6 +153,7 @@ def multiproc_up(nodes: int = 3, node_cpu: str = "8", node_mem: str = "16Gi",
                  listen_host: str = "127.0.0.1", bus_port: int = 0,
                  standby_scheduler: bool = False,
                  schedule_period: float = 0.2,
+                 micro_cycles: bool = False,
                  ) -> Tuple[object, List[subprocess.Popen]]:
     """The reference's deployment topology as real OS processes:
     vtpu-apiserver + vtpu-admission + vtpu-controllers + vtpu-scheduler
@@ -189,6 +192,8 @@ def multiproc_up(nodes: int = 3, node_cpu: str = "8", node_mem: str = "16Gi",
             "--bus", bus_url, "--listen-port", "0",
             "--schedule-period", str(schedule_period),
         ]
+        if micro_cycles:
+            scheduler_flags.append("--micro-cycles")
         if scheduler_conf:
             scheduler_flags += ["--scheduler-conf", scheduler_conf]
         n_schedulers = 2 if standby_scheduler else 1
@@ -284,6 +289,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--scheduler-port", type=int, default=0)
     parser.add_argument("--controllers-port", type=int, default=0)
     parser.add_argument("--admission-port", type=int, default=0)
+    parser.add_argument("--micro-cycles", action="store_true",
+                        help="event-driven scheduler: wake on watch "
+                        "events and run debounced micro-cycles between "
+                        "the periodic full cycles")
     parser.add_argument("--scheduler-conf", default="",
                         help="scheduler policy YAML, hot-reloaded per cycle")
     return parser
@@ -316,6 +325,7 @@ def main(argv=None) -> int:
             listen_host=args.listen_host,
             bus_port=args.bus_port,
             standby_scheduler=args.standby_scheduler,
+            micro_cycles=args.micro_cycles,
         )
         print(f"multi-process control plane up: bus {api.address}, "
               f"{len(procs)} daemons "
@@ -348,6 +358,7 @@ def main(argv=None) -> int:
         admission_port=args.admission_port,
         controllers_port=args.controllers_port,
         scheduler_port=args.scheduler_port,
+        micro_cycles=args.micro_cycles,
         api=remote,
     )
     print(

@@ -41,12 +41,18 @@ class SchedulerDaemon(BaseDaemon):
         snapshot_reuse: bool = False,
         cycle_deadline_ms=None,
         pipelined_commit: bool = False,
+        micro_cycles: bool = False,
+        micro_debounce_ms: float = 5.0,
         **daemon_kw,
     ):
         # /explain reads self.cache lazily (set right below) — the
-        # serving server only dereferences at request time
+        # serving server only dereferences at request time.  In micro
+        # mode one _work call IS a whole schedule-period window (the
+        # scheduler waits on its condition variable inside), so the
+        # daemon's own inter-work sleep shrinks to a leadership-check
+        # granularity instead of stacking a second period on top.
         super().__init__(
-            api, period=schedule_period,
+            api, period=0.05 if micro_cycles else schedule_period,
             explain_source=lambda ns, job: _explain_source(self, ns, job),
             **daemon_kw,
         )
@@ -60,13 +66,24 @@ class SchedulerDaemon(BaseDaemon):
             self.cache, scheduler_conf_path=scheduler_conf,
             period=schedule_period, gc_quiesce_period=gc_quiesce_period,
             cycle_deadline_ms=cycle_deadline_ms,
+            micro_cycles=micro_cycles,
+            micro_debounce_ms=micro_debounce_ms,
         )
 
     def _on_start(self) -> None:
         self.cache.run()
 
     def _work(self) -> None:
-        self.scheduler.run_once()
+        if self.scheduler.micro_cycles:
+            self.scheduler.run_cycle_window()
+        else:
+            self.scheduler.run_once()
+
+    def stop(self, crash: bool = False) -> None:
+        # wake the scheduler's condition wait first, or the loop join
+        # would wait out the in-flight window
+        self.scheduler.stop()
+        super().stop(crash=crash)
 
 
 def add_common_args(parser: argparse.ArgumentParser) -> None:
@@ -129,6 +146,20 @@ def main(argv=None) -> int:
         "queue onto bind workers, coalesce into batched commit frames, "
         "and a commit barrier at the next snapshot preserves coherence "
         "and replay bit-identity",
+    )
+    parser.add_argument(
+        "--micro-cycles", action="store_true",
+        help="event-driven scheduling: wake on watch-event arrival and "
+        "run an incremental micro-cycle over the coalesced change "
+        "instead of waiting out --schedule-period; full cycles keep "
+        "running every period for fair-share/gang re-equilibration "
+        "(bindings stay bit-identical to the periodic loop)",
+    )
+    parser.add_argument(
+        "--micro-debounce-ms", type=float, default=5.0,
+        help="event-storm coalescing window: after the first watch "
+        "event wakes the loop, wait this long so the rest of the burst "
+        "lands in the same micro-cycle",
     )
     parser.add_argument(
         "--warmup", action="store_true",
@@ -202,6 +233,8 @@ def main(argv=None) -> int:
             snapshot_reuse=args.snapshot_reuse,
             cycle_deadline_ms=args.cycle_deadline_ms or None,
             pipelined_commit=args.pipelined_commit,
+            micro_cycles=args.micro_cycles,
+            micro_debounce_ms=args.micro_debounce_ms,
             listen_host=args.listen_host,
             listen_port=args.listen_port,
             leader_elect=args.leader_elect,

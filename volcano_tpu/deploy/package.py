@@ -65,6 +65,12 @@ DEFAULT_VALUES: Dict[str, Any] = {
         # the kubelet's restart of a dead leader beats any takeover.
         # Default to 1; set 2 (adds --leader-elect) where slices exist.
         "replicas": 1,
+        # event-driven scheduling (adds --micro-cycles): wake on watch
+        # events and run debounced micro-cycles between the periodic
+        # full cycles.  Bindings stay bit-identical to the fixed-period
+        # loop; submit→bind latency under churn drops from ~a period to
+        # ~a cycle.  Off only for debugging cadence-sensitive policies.
+        "micro_cycles": True,
     },
     "controllers": {
         "port": 8081,
@@ -303,6 +309,8 @@ def render(values: Dict[str, Any]) -> List[Tuple[str, Dict[str, Any]]]:
         "--listen-port", str(sched_port),
         "--scheduler-conf", "/etc/volcano-tpu/volcano-scheduler.conf",
     ]
+    if values["scheduler"].get("micro_cycles"):
+        sched_cmd.append("--micro-cycles")
     if sched_replicas > 1:
         sched_cmd.append("--leader-elect")
     scheduler: Dict[str, Any] = {

@@ -417,14 +417,21 @@ def run_packed_auto(
 
 
 def warmup_kernels(n_tasks: int = 4096, n_nodes: int = 1024,
-                   gang_size: int = 8) -> str:
+                   gang_size: int = 8, micro_shapes: bool = True) -> str:
     """Populate the jit cache for the session kernels at a
     representative shape bucket (first TPU compile is ~20-40s; every
     same-bucket session after is cache-hit) and log the duration.
     Returns the executor auto-dispatch SELECTED — if the run degraded to
     a fallback mid-warmup, the dispatcher logged that error itself.
     Shared by the compute-plane sidecar's and the scheduler daemon's
-    ``--warmup`` flags."""
+    ``--warmup`` flags.
+
+    ``micro_shapes`` additionally compiles the minimum task bucket at
+    the same node count: event-driven micro-cycles score a handful of
+    freshly-arrived tasks per wake ([64, N] sessions, usually the
+    small-area scan path rather than the headline formulation), and
+    without this the FIRST event after startup pays that compile inside
+    its submit→bind latency."""
     import time
 
     from volcano_tpu.ops.synthetic import generate_snapshot
@@ -436,6 +443,11 @@ def warmup_kernels(n_tasks: int = 4096, n_nodes: int = 1024,
     executor = select_executor(snap)
     t0 = time.monotonic()
     run_packed_auto(snap)
+    if micro_shapes and n_tasks > 64:
+        micro_snap = generate_snapshot(
+            n_tasks=48, n_nodes=n_nodes, gang_size=1
+        )
+        run_packed_auto(micro_snap)
     get_logger(__name__).info(
         "warmup compile (%s) done in %.1fs", executor, time.monotonic() - t0
     )

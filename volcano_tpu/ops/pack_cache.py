@@ -336,6 +336,136 @@ class PackCache:
         }
         return snap
 
+    # ---- micro pack: fresh task rows over warm node planes ----
+
+    def _fresh_task_pack(
+        self,
+        tasks: Sequence,
+        jobs: Sequence,
+        nodes: Sequence,
+        epoch,
+        enforce_pod_count: bool,
+        names,
+        tol,
+        pending,
+    ) -> PackedSnapshot:
+        """Assemble a snapshot whose TASK planes are rebuilt fresh (new
+        bucket, every row re-packed — O(pending tasks)) while the NODE
+        planes stay warm (dirty rows only, exactly :meth:`pack`'s node
+        phase) and the label/taint registries persist.
+
+        This is the micro-cycle's subset pack: under sustained churn the
+        pending set is tiny and its bucket crosses power-of-two
+        boundaries constantly, so gather-reuse is worthless there but
+        the O(nodes) planes — the expensive half at 10k nodes — are
+        fully reusable.  Equivalence contract is the warm path's:
+        bit-identical to a cold ``pack_session`` seeded with the
+        resulting registries (tests/test_pack_cache.py), so device
+        bindings cannot differ from a full cycle's.
+
+        Preconditions (checked by :meth:`pack`): same node set/topology/
+        resource axis/enforce flag, no registry overflow."""
+        t0 = time.perf_counter()
+        prev = self._snap
+        tasks_list = list(tasks)
+        T, N, J = len(tasks_list), len(nodes), len(jobs)
+        snap = self._alloc_snap(names, tol, T, N, J)
+        delta_planes: Dict[str, Optional[np.ndarray]] = {}
+
+        # --- node planes (possibly pre-assembled by begin_nodes) ---
+        label_size0 = len(self.label_reg.index)
+        if pending is None or pending["epoch_rev"] != epoch.rev:
+            pending = self._node_phase(list(nodes), epoch, enforce_pod_count)
+        node_planes = pending["planes"]
+        node_dirty = pending["dirty_pos"]
+        node_full = pending["full_pos"]
+        for name, arr in node_planes.items():
+            setattr(snap, name, arr)
+            rows = node_dirty if name in NODE_DYNAMIC_PLANES else node_full
+            if rows.size:
+                delta_planes[name] = rows
+
+        # --- fresh task planes ---
+        self._task_mem_ok = np.ones(snap.task_resreq.shape[0], dtype=bool)
+        self._exists_uids = set()
+        for i, t in enumerate(tasks_list):
+            self._repack_task_row(snap, i, t)
+        # keyed-Exists tolerations resolve against the now-complete
+        # registry (persistent pairs + anything the rows above and the
+        # node phase registered) — the cold pack's post-node-pass step
+        resolve_exists_tolerations(snap, enumerate(tasks_list), self.taint_reg)
+        # coupling: a NEW label pair registered by a fresh selector must
+        # set the bit on every warm node row carrying that label, as a
+        # cold pack's node pass would have
+        patched = set()
+        if len(self.label_reg.index) > label_size0:
+            for pair, idx in list(self.label_reg.index.items())[label_size0:]:
+                for npos in self._label_to_nodes.get(pair, ()):
+                    snap.node_label_bits[npos, idx // 32] |= np.uint32(
+                        1 << (idx % 32)
+                    )
+                    patched.add(npos)
+        if patched:
+            delta_planes["node_label_bits"] = np.asarray(
+                sorted(patched | set(node_full.tolist())), dtype=np.int64
+            )
+
+        # --- job planes + positional task_job ---
+        curr_uids = [t.uid for t in tasks_list]
+        job_uids = [j.uid for j in jobs]
+        job_index = {uid: i for i, uid in enumerate(job_uids)}
+        task_jobs = [t.job for t in tasks_list]
+        if T:
+            snap.task_job[:T] = [job_index.get(j, 0) for j in task_jobs]
+        for i, j in enumerate(jobs):
+            snap.job_min_available[i] = j.min_available
+            snap.job_ready_count[i] = j.ready_task_num()
+            snap.job_uids.append(j.uid)
+
+        # --- flags + delta vs previous pack ---
+        snap.task_uids = curr_uids
+        snap.node_names = list(self._node_names)
+        snap.registry_overflow = bool(
+            self.label_reg.overflow or self.taint_reg.overflow
+        )
+        snap.needs_host_validation = bool(
+            snap.task_needs_host[:T].any() or snap.registry_overflow
+        )
+        snap.memory_exact = bool(
+            self._task_mem_ok[:T].all()
+            and self._node_mem_static_ok[:N].all()
+            and self._node_mem_dyn_ok[:N].all()
+        )
+        for name in TASK_PLANES:  # includes task_job
+            delta_planes[name] = None  # wholesale: the bucket changed
+        for name in JOB_PLANES:
+            if not np.array_equal(getattr(prev, name), getattr(snap, name)):
+                delta_planes[name] = None
+        if not np.array_equal(prev.tolerance, snap.tolerance):
+            delta_planes["tolerance"] = None
+
+        # --- bookkeeping (the micro pack IS the next warm base) ---
+        self._task_uids = curr_uids
+        self._task_pos = {uid: i for i, uid in enumerate(curr_uids)}
+        self._task_jobs = task_jobs
+        self._job_uids = job_uids
+        self._snap = snap
+        self.rev += 1
+        snap.cache_key = self.key
+        snap.rev = self.rev
+        snap.delta = PackDelta(self.rev - 1, delta_planes)
+        self._consumed_rev = epoch.rev
+        if self.cache is not None:
+            self.cache.clear_dirty_through(epoch)
+        self.last_stats = {
+            "mode": "micro",
+            "repacked_tasks": T,
+            "reused_tasks": 0,
+            "repacked_nodes": int(node_dirty.size),
+            "pack_ms": (time.perf_counter() - t0) * 1e3,
+        }
+        return snap
+
     # ---- node phase (callable before ORDER so staging overlaps it) ----
 
     def begin_nodes(self, nodes: Sequence, epoch, enforce_pod_count: bool = True):
@@ -426,21 +556,44 @@ class PackCache:
             )
         names, tol = _resource_axis(tasks, nodes)
         node_names = [n.name for n in nodes]
-        if (
-            self._snap is None
-            or epoch.topology_rev != self._topo_rev
-            or names != self._names_prev
-            or node_names != self._node_names
-            or enforce_pod_count != self._enforce_prev
-            or _bucket(len(tasks)) != self._snap.task_resreq.shape[0]
-            or _bucket(len(nodes)) != self._snap.node_idle.shape[0]
+        # Cold-rebuild causes, in precedence order.  (node_names equality
+        # implies equal node counts, so a node-bucket change can only
+        # arrive as "node-set".)  The cause string lands in last_stats so
+        # a micro-triggered cycle can attribute its full-cost fallback
+        # (volcano_full_cycle_fallbacks_total{cause}).
+        cold_cause = None
+        if self._snap is None:
+            cold_cause = "first-pack"
+        elif epoch.topology_rev != self._topo_rev:
+            cold_cause = "topology"
+        elif names != self._names_prev:
+            cold_cause = "axis-change"
+        elif node_names != self._node_names:
+            cold_cause = "node-set"
+        elif enforce_pod_count != self._enforce_prev:
+            cold_cause = "plugin-set"
+        elif self.label_reg.overflow or self.taint_reg.overflow:
             # an overflowed registry recovers via the cold path's
             # registry rebuild — one cold pack instead of a permanently
             # latched needs_host_validation
-            or self.label_reg.overflow
-            or self.taint_reg.overflow
-        ):
-            return self._cold(tasks, jobs, nodes, epoch, enforce_pod_count)
+            cold_cause = "registry-overflow"
+        if cold_cause is not None:
+            snap = self._cold(tasks, jobs, nodes, epoch, enforce_pod_count)
+            self.last_stats["cold_cause"] = cold_cause
+            return snap
+        if _bucket(len(tasks)) != self._snap.task_resreq.shape[0]:
+            # task-bucket change — the sustained-churn steady state,
+            # where the pending set's size crosses power-of-two
+            # boundaries every few cycles.  This used to force a COLD
+            # pack (O(tasks + nodes) rebuild, registries reset); the
+            # micro path instead rebuilds ONLY the task planes fresh
+            # (O(pending), typically tiny under churn) against the warm
+            # node planes and persistent registries — the subset-pack
+            # half of the event-driven micro-cycle.
+            return self._fresh_task_pack(
+                tasks, jobs, nodes, epoch, enforce_pod_count, names, tol,
+                pending,
+            )
 
         t0 = time.perf_counter()
         prev = self._snap
