@@ -70,25 +70,21 @@ class LoadgenTopology:
     def __init__(self, n_nodes: int, node_cpu: int, conf_path: str,
                  period: float, debounce_ms: float,
                  micro_cycles: bool = True):
-        from volcano_tpu.bus.remote import RemoteAPIServer
+        self._init_store(n_nodes, node_cpu)
+        self._start_scheduler(conf_path, period, debounce_ms, micro_cycles)
+
+    def _init_store(self, n_nodes: int, node_cpu: int) -> None:
         from volcano_tpu.bus.server import BusServer
-        from volcano_tpu.cache import SchedulerCache
         from volcano_tpu.client import (
             ADDED,
             APIServer,
             KubeClient,
             MODIFIED,
-            SchedulerClient,
             VolcanoClient,
         )
-        from volcano_tpu.scheduler.scheduler import Scheduler
 
         self.api = APIServer()
         self.bus = BusServer(self.api).start()
-        self.sched_remote = RemoteAPIServer(
-            f"tcp://127.0.0.1:{self.bus.port}", timeout=10.0
-        )
-        assert self.sched_remote.wait_ready(10.0)
         # arrivals land on the in-process store (the generator is
         # colocated with the apiserver, off the measured path) and reach
         # the SCHEDULER over the real TCP watch stream — the measured
@@ -134,6 +130,17 @@ class LoadgenTopology:
         )
         self._reaper.start()
 
+    def _start_scheduler(self, conf_path: str, period: float,
+                         debounce_ms: float, micro_cycles: bool) -> None:
+        from volcano_tpu.bus.remote import RemoteAPIServer
+        from volcano_tpu.cache import SchedulerCache
+        from volcano_tpu.client import SchedulerClient
+        from volcano_tpu.scheduler.scheduler import Scheduler
+
+        self.sched_remote = RemoteAPIServer(
+            f"tcp://127.0.0.1:{self.bus.port}", timeout=10.0
+        )
+        assert self.sched_remote.wait_ready(10.0)
         self.cache = SchedulerCache(
             client=SchedulerClient(self.sched_remote),
             scheduler_name="volcano-tpu",
@@ -204,6 +211,123 @@ class LoadgenTopology:
         self._thread.join(timeout=15)
         self.cache.stop_commit_plane()
         self.sched_remote.close()
+        self.bus.stop()
+
+
+class FederatedTopology(LoadgenTopology):
+    """The sharded federation under load, topology fully real: the same
+    in-process store + TCP bus + audit watch, but scheduling is done by
+    ``--shards N`` **separate OS processes** running the actual
+    ``vtpu-scheduler`` binary — shard-assignment leases, filtered
+    informers, spillover CAS binds, pipelined commits, micro-cycles,
+    the lot.  This is the harness behind the 1M-pods/100k-nodes
+    aggregate headline and the near-linear 1→4 shard throughput claim.
+    """
+
+    def __init__(self, n_nodes: int, node_cpu: int, conf_path: str,
+                 period: float, debounce_ms: float, n_shards: int,
+                 lease_duration: float = 2.0,
+                 micro_cycles: bool = True,
+                 startup_timeout: float = 180.0,
+                 log_dir: str = ""):
+        import subprocess
+
+        self._init_store(n_nodes, node_cpu)
+        self.n_shards = n_shards
+        self.procs = []
+        self._logs = []
+        url = f"tcp://127.0.0.1:{self.bus.port}"
+        for i in range(n_shards):
+            cmd = [
+                sys.executable, "-m", "volcano_tpu.cmd.scheduler",
+                "--bus", url,
+                "--shards", str(n_shards),
+                "--shard-identity", f"shard{i}",
+                "--shard-lease-duration", str(lease_duration),
+                "--schedule-period", str(period),
+                "--micro-debounce-ms", str(debounce_ms),
+                "--pipelined-commit", "--snapshot-reuse",
+                "--scheduler-conf", conf_path,
+                "--listen-port", "0",
+            ]
+            if micro_cycles:
+                cmd.append("--micro-cycles")
+            log_path = os.path.join(
+                log_dir or tempfile.gettempdir(), f"loadgen-shard{i}.log"
+            )
+            logf = open(log_path, "w")  # noqa: SIM115 — held for the proc
+            self._logs.append(logf)
+            self.procs.append(subprocess.Popen(
+                cmd, stdout=logf, stderr=subprocess.STDOUT,
+                env=dict(os.environ),
+            ))
+        self._wait_federation(startup_timeout)
+
+    def _wait_federation(self, timeout: float) -> None:
+        from volcano_tpu.federation import read_shard_map
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for p in self.procs:
+                rc = p.poll()
+                if rc is not None:
+                    raise RuntimeError(
+                        f"shard scheduler exited rc={rc} during startup"
+                    )
+            rec = read_shard_map(self.api)
+            if rec is not None:
+                holders = {
+                    e.get("holder")
+                    for e in rec.get("shards", {}).values()
+                }
+                if "" not in holders and None not in holders and len(
+                    rec.get("members", {})
+                ) >= self.n_shards:
+                    return
+            time.sleep(0.1)
+        raise RuntimeError(
+            f"federation did not form within {timeout}s "
+            f"(map: {read_shard_map(self.api)})"
+        )
+
+    def kill_member(self, index: int) -> str:
+        """SIGKILL one shard scheduler process mid-run — the loadgen
+        face of the shard-kill chaos scenario.  Survivors must absorb
+        its slices within one lease TTL and the drain still requires
+        every pod to bind."""
+        proc = self.procs[index]
+        proc.kill()
+        proc.wait(timeout=10)
+        return f"shard{index}"
+
+    def shard_report(self) -> dict:
+        from volcano_tpu.federation import read_shard_map
+
+        rec = read_shard_map(self.api) or {}
+        return {
+            "shards": self.n_shards,
+            "holders": {
+                i: e.get("holder")
+                for i, e in rec.get("shards", {}).items()
+            },
+            "members": sorted(rec.get("members", {})),
+            "stats": rec.get("stats", {}),
+        }
+
+    def close(self):
+        self._reaper_stop.set()
+        self._reaper.join(timeout=5)
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — escalate to SIGKILL
+                p.kill()
+                p.wait(timeout=5)
+        for f in self._logs:
+            f.close()
         self.bus.stop()
 
 
@@ -297,18 +421,19 @@ def run_phase(topo: LoadgenTopology, rate: float, duration: float,
         time.sleep(0.05)
 
     with topo._bind_lock:
-        lat = [
-            (topo.bind_ts[k] - submit_ts[k]) * 1e3
+        pairs = [
+            (k, (topo.bind_ts[k] - submit_ts[k]) * 1e3)
             for k in all_keys if k in topo.bind_ts
         ]
         last_bind = max(
             (topo.bind_ts[k] for k in all_keys if k in topo.bind_ts),
             default=wall0,
         )
+    lat = [v for _k, v in pairs]
     bound = len(lat)
     lat_arr = np.asarray(lat) if lat else np.asarray([float("nan")])
     span = max(last_bind - wall0, 1e-9)
-    return {
+    report = {
         "offered_rate_jobs_per_s": rate,
         "jobs": n_jobs,
         "tasks_per_job": tasks_per_job,
@@ -321,6 +446,31 @@ def run_phase(topo: LoadgenTopology, rate: float, duration: float,
         "max_ms": round(float(lat_arr.max()), 3),
         "achieved_pods_per_s": round(bound / span, 1),
     }
+    n_shards = getattr(topo, "n_shards", 0)
+    if n_shards > 1:
+        # per-shard percentiles, grouped by each pod's HOME shard (the
+        # scheduler accountable for it — spillover binds still count
+        # toward the home shard's latency, which is the user-visible
+        # attribution)
+        from volcano_tpu.federation.sharding import home_shard
+
+        by_shard: Dict[int, List[float]] = {}
+        for key, v in pairs:
+            ns, name = key.split("/", 1)
+            group = name.rsplit("-t", 1)[0]
+            by_shard.setdefault(
+                home_shard(ns, group, n_shards), []
+            ).append(v)
+        report["per_shard"] = {
+            str(s): {
+                "bound_pods": len(vals),
+                "p50_ms": round(float(np.percentile(vals, 50)), 3),
+                "p95_ms": round(float(np.percentile(vals, 95)), 3),
+                "p99_ms": round(float(np.percentile(vals, 99)), 3),
+            }
+            for s, vals in sorted(by_shard.items())
+        }
+    return report
 
 
 def _cycle_mix(topo: LoadgenTopology) -> dict:
@@ -341,44 +491,99 @@ def _cycle_mix(topo: LoadgenTopology) -> dict:
     }
 
 
+def _warm_names(label: str, n_shards: int):
+    """Warm job names covering every home shard (so each federation
+    member compiles its kernels off the clock, not on the first
+    measured arrival)."""
+    from volcano_tpu.federation.sharding import home_shard
+
+    out = []
+    for shard in range(max(n_shards, 1)):
+        k = 0
+        while True:
+            name = f"{label}-warm-s{shard}-{k}"
+            if n_shards <= 1 or home_shard("ns", name, n_shards) == shard:
+                out.append(name)
+                break
+            k += 1
+    return out
+
+
 def run_loadgen(args) -> dict:
     with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as f:
         f.write(CONF)
         conf_path = f.name
 
     def fresh_topo():
-        topo = LoadgenTopology(
-            n_nodes=args.nodes, node_cpu=args.node_cpu,
-            conf_path=conf_path, period=args.period,
-            debounce_ms=args.debounce_ms,
-            micro_cycles=not args.no_micro_cycles,
-        )
+        if args.shards > 0:
+            topo = FederatedTopology(
+                n_nodes=args.nodes, node_cpu=args.node_cpu,
+                conf_path=conf_path, period=args.period,
+                debounce_ms=args.debounce_ms,
+                n_shards=args.shards,
+                lease_duration=args.shard_lease_duration,
+                micro_cycles=not args.no_micro_cycles,
+            )
+        else:
+            topo = LoadgenTopology(
+                n_nodes=args.nodes, node_cpu=args.node_cpu,
+                conf_path=conf_path, period=args.period,
+                debounce_ms=args.debounce_ms,
+                micro_cycles=not args.no_micro_cycles,
+            )
         topo.complete_after_s = args.complete_after_s
         return topo
 
     def one_run(rate: float, label: str) -> dict:
         topo = fresh_topo()
+        killer = None
         try:
             # warmup: prime the jit cache + watch streams off the clock,
             # so the first measured pod doesn't pay a kernel compile.
             # Two bursts of different sizes walk the scatter/kernel
-            # shape buckets a churning run will actually hit.
+            # shape buckets a churning run will actually hit; federated
+            # runs warm EVERY member (one name per home shard).
             deadline = time.monotonic() + args.warmup_timeout
             for wi, burst in enumerate((4, 24)):
-                warm = topo.submit_job(f"{label}-warm{wi}", burst, args.cpu)
+                warm = []
+                for name in _warm_names(f"{label}w{wi}", args.shards):
+                    warm.extend(topo.submit_job(name, burst, args.cpu))
                 while time.monotonic() < deadline:
                     if topo.bound_count(warm) == len(warm):
                         break
                     time.sleep(0.05)
                 if topo.bound_count(warm) != len(warm):
                     raise RuntimeError("warmup pods never bound")
+            if args.shards > 0 and args.kill_shard_after > 0:
+                # the shard-kill scenario under load: SIGKILL member 0
+                # mid-stream; survivors must absorb its slices and the
+                # drain still requires every pod to bind
+                killer = threading.Timer(
+                    args.kill_shard_after,
+                    lambda: topo.kill_member(0),
+                )
+                killer.daemon = True
+                killer.start()
             report = run_phase(
                 topo, rate, args.duration, args.tasks_per_job, args.cpu,
                 args.drain_timeout, label=label,
             )
-            report.update(_cycle_mix(topo))
+            if hasattr(topo, "scheduler"):
+                report.update(_cycle_mix(topo))
+            if args.shards > 0:
+                report["federation"] = topo.shard_report()
+                if args.kill_shard_after > 0:
+                    report["killed_member"] = "shard0"
+                from volcano_tpu.federation import verify_federation
+
+                policy = verify_federation(topo.api, args.shards)
+                report["policy_equivalent"] = policy["ok"]
+                if not policy["ok"]:
+                    report["policy_violations"] = policy["violations"][:20]
             return report
         finally:
+            if killer is not None:
+                killer.cancel()
             topo.close()
 
     out = {
@@ -390,6 +595,7 @@ def run_loadgen(args) -> dict:
             "debounce_ms": args.debounce_ms,
             "schedule_period_s": args.period,
             "micro_cycles": not args.no_micro_cycles,
+            "shards": args.shards,
             "quick": args.quick,
         },
     }
@@ -450,6 +656,18 @@ def main(argv=None) -> int:
     p.add_argument("--saturation-steps", type=int, default=4)
     p.add_argument("--slo-ms", type=float, default=100.0,
                    help="p99 submit→bind SLO the saturation ramp gates on")
+    p.add_argument("--shards", type=int, default=0,
+                   help="sharded scheduler federation: spawn N real "
+                   "vtpu-scheduler OS processes over the TCP bus, each "
+                   "owning a node shard via CAS leases, and report "
+                   "per-shard + aggregate percentiles (0 = the "
+                   "single-scheduler topology)")
+    p.add_argument("--shard-lease-duration", type=float, default=2.0)
+    p.add_argument("--kill-shard-after", type=float, default=0.0,
+                   help="SIGKILL shard member 0 this many seconds into "
+                   "the measured stream (federation chaos: survivors "
+                   "must absorb its slices within one lease TTL and "
+                   "every pod must still bind)")
     p.add_argument("--quick", action="store_true",
                    help="CI smoke preset: small fleet, short stream")
     args = p.parse_args(argv)
@@ -470,6 +688,10 @@ def main(argv=None) -> int:
     if r["bound_pods"] != r["submitted_pods"]:
         print(f"LOADGEN FAIL: {r['submitted_pods'] - r['bound_pods']} pods "
               f"never bound", file=sys.stderr)
+        return 1
+    if args.shards > 0 and not r.get("policy_equivalent", True):
+        print("LOADGEN FAIL: federation run is not policy-equivalent: "
+              f"{r.get('policy_violations')}", file=sys.stderr)
         return 1
     return 0
 

@@ -1,0 +1,135 @@
+"""prof_bus_serde — pin the once-per-event VBUS encode under fan-out.
+
+The federation topology multiplies watch subscribers: every store
+mutation fans out to N scheduler processes (plus controllers), and
+before this PR the server re-ran ``json.dumps`` on the same event entry
+once per subscriber — encode cost scaled O(subscribers), the named
+prerequisite (ROADMAP item 4) for scaling the scheduler count.  Now the
+entry body is serialized once (``bus/server.py::_CachedPayload``) and
+the cached bytes are shared by every per-connection writer and spliced
+into ``watch_batch`` frames.
+
+This profile counts both sides of the cache — ``raw()`` *calls* (the
+per-subscriber fan-out) vs actual *encodes* — while M real TCP
+subscribers drain K store mutations, and fails when encodes stop being
+O(events).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python bench/prof_bus_serde.py
+    python bench/prof_bus_serde.py --subscribers 8 --events 2000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def run(subscribers: int, events: int, timeout: float) -> dict:
+    from volcano_tpu.apis import core
+    from volcano_tpu.bus import protocol
+    from volcano_tpu.bus import server as server_mod
+    from volcano_tpu.bus.remote import RemoteAPIServer
+    from volcano_tpu.bus.server import BusServer
+    from volcano_tpu.client import APIServer
+
+    counts = {"fanout_calls": 0, "encodes": 0}
+    lock = threading.Lock()
+    original_raw = server_mod._CachedPayload.raw
+
+    def counting_raw(self):
+        with lock:
+            counts["fanout_calls"] += 1
+            if self._raw is None:
+                counts["encodes"] += 1
+        return original_raw(self)
+
+    server_mod._CachedPayload.raw = counting_raw
+    api = APIServer()
+    bus = BusServer(api).start()
+    clients = []
+    seen = [0] * subscribers
+    done = threading.Event()
+
+    def handler_for(i):
+        def handler(event, old, new):
+            seen[i] += 1
+            if all(s >= events for s in seen):
+                done.set()
+        return handler
+
+    try:
+        for i in range(subscribers):
+            c = RemoteAPIServer(f"tcp://127.0.0.1:{bus.port}", timeout=10.0)
+            assert c.wait_ready(10.0)
+            c.watch("Pod", handler_for(i), send_initial=False)
+            clients.append(c)
+        time.sleep(0.2)  # let every watch land before the clock starts
+        start = time.perf_counter()
+        for n in range(events):
+            api.create(core.Pod(
+                metadata=core.ObjectMeta(name=f"p{n:06d}", namespace="ns"),
+                spec=core.PodSpec(),
+                status=core.PodStatus(phase="Pending"),
+            ))
+        if not done.wait(timeout):
+            raise RuntimeError(
+                f"subscribers drained only {seen} of {events} events "
+                f"within {timeout}s"
+            )
+        elapsed = time.perf_counter() - start
+    finally:
+        server_mod._CachedPayload.raw = original_raw
+        for c in clients:
+            c.close()
+        bus.stop()
+
+    delivered = sum(seen)
+    # bookmarks also ride cached payloads — allow their small overhead
+    # in the encode budget, but the per-subscriber fan-out must not
+    # re-encode: encodes must track events, not events × subscribers
+    encodes_per_event = counts["encodes"] / max(events, 1)
+    return {
+        "harness": "prof_bus_serde",
+        "subscribers": subscribers,
+        "events": events,
+        "delivered_frames_worth": delivered,
+        "elapsed_s": round(elapsed, 4),
+        "delivered_per_s": round(delivered / max(elapsed, 1e-9), 1),
+        "encodes": counts["encodes"],
+        "fanout_raw_calls": counts["fanout_calls"],
+        "encodes_per_event": round(encodes_per_event, 4),
+        "legacy_encodes_would_be": events * subscribers,
+        "ok": encodes_per_event <= 1.5,  # 1 + bookmark slack
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="prof_bus_serde")
+    p.add_argument("--subscribers", type=int, default=4)
+    p.add_argument("--events", type=int, default=1000)
+    p.add_argument("--timeout", type=float, default=60.0)
+    args = p.parse_args(argv)
+    report = run(args.subscribers, args.events, args.timeout)
+    json.dump(report, sys.stdout, indent=2)
+    sys.stdout.write("\n")
+    if not report["ok"]:
+        print(
+            f"PROF_BUS_SERDE FAIL: {report['encodes_per_event']} encodes "
+            f"per event (expected ~1 regardless of "
+            f"{args.subscribers} subscribers)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

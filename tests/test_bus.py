@@ -866,3 +866,153 @@ class TestWatchBatch:
         finally:
             client.close()
             srv.stop()
+
+
+class TestCasBind:
+    """Protocol v4 ``cas_bind``: one optimistic binding write — the
+    federation spillover primitive.  Conflicts are typed and identical
+    over both backends; a pre-v4 server degrades the client to the
+    get + CAS-update equivalent."""
+
+    @staticmethod
+    def _pod(name, ns="ns"):
+        return core.Pod(
+            metadata=core.ObjectMeta(name=name, namespace=ns),
+            spec=core.PodSpec(),
+            status=core.PodStatus(phase="Pending"),
+        )
+
+    def test_cas_bind_over_the_wire(self):
+        api = APIServer()
+        srv = BusServer(api).start()
+        client = RemoteAPIServer(f"tcp://127.0.0.1:{srv.port}", timeout=5)
+        try:
+            assert client.wait_ready(5)
+            pod = client.create(self._pod("p1"))
+            bound = client.cas_bind(
+                "ns", "p1", "n1",
+                expected_rv=pod.metadata.resource_version,
+            )
+            assert bound.spec.node_name == "n1"
+            assert api.get("Pod", "ns", "p1").spec.node_name == "n1"
+            with pytest.raises(ConflictError):
+                client.cas_bind("ns", "p1", "n2")
+            with pytest.raises(NotFoundError):
+                client.cas_bind("ns", "nope", "n1")
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_cas_bind_stale_rv_conflicts(self):
+        api = APIServer()
+        srv = BusServer(api).start()
+        client = RemoteAPIServer(f"tcp://127.0.0.1:{srv.port}", timeout=5)
+        try:
+            assert client.wait_ready(5)
+            pod = client.create(self._pod("p1"))
+            stale = pod.metadata.resource_version
+            pod.metadata.labels["x"] = "y"
+            client.update(pod)
+            with pytest.raises(ConflictError):
+                client.cas_bind("ns", "p1", "n1", expected_rv=stale)
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_old_server_falls_back_to_get_plus_cas_update(self, monkeypatch):
+        """A pre-v4 server answers `unknown bus op` for cas_bind — the
+        client degrades permanently (per connection) to get + CAS
+        update, with identical conflict semantics."""
+        from volcano_tpu.client.apiserver import ApiError
+
+        real_execute = BusServer._execute
+
+        def v3_execute(self, conn, req_id, payload, op):
+            if op == "cas_bind":
+                raise ApiError("unknown bus op 'cas_bind'")
+            return real_execute(self, conn, req_id, payload, op)
+
+        monkeypatch.setattr(BusServer, "_execute", v3_execute)
+        api = APIServer()
+        srv = BusServer(api).start()
+        client = RemoteAPIServer(f"tcp://127.0.0.1:{srv.port}", timeout=5)
+        try:
+            assert client.wait_ready(5)
+            pod = client.create(self._pod("p1"))
+            bound = client.cas_bind(
+                "ns", "p1", "n1",
+                expected_rv=pod.metadata.resource_version,
+            )
+            assert bound.spec.node_name == "n1"
+            assert client._no_cas_bind is True
+            assert api.get("Pod", "ns", "p1").spec.node_name == "n1"
+            # conflict semantics survive the fallback
+            client.create(self._pod("p2"))
+            api.cas_bind("ns", "p2", "elsewhere")
+            with pytest.raises(ConflictError):
+                client.cas_bind("ns", "p2", "n1")
+        finally:
+            client.close()
+            srv.stop()
+
+
+class TestSerdeOncePerEvent:
+    """The fan-out serde hot path (ISSUE 9 satellite): a watch event's
+    frame body is serialized once per EVENT, no matter how many
+    subscribers receive it — the named prerequisite for multi-scheduler
+    federation (ROADMAP item 4's serde note)."""
+
+    def test_event_encodes_once_for_many_subscribers(self, monkeypatch):
+        from volcano_tpu.bus import server as server_mod
+
+        counts = {"encodes": 0, "calls": 0}
+        original_raw = server_mod._CachedPayload.raw
+
+        def counting_raw(self):
+            counts["calls"] += 1
+            if self._raw is None:
+                counts["encodes"] += 1
+            return original_raw(self)
+
+        monkeypatch.setattr(server_mod._CachedPayload, "raw", counting_raw)
+        api = APIServer()
+        srv = BusServer(api, bookmark_interval=3600).start()
+        clients, seen = [], []
+        try:
+            for i in range(3):
+                c = RemoteAPIServer(f"tcp://127.0.0.1:{srv.port}",
+                                    timeout=5)
+                assert c.wait_ready(5)
+                n_seen = [0]
+                seen.append(n_seen)
+                c.watch("ConfigMap",
+                        lambda e, o, n, s=n_seen: s.__setitem__(
+                            0, s[0] + 1),
+                        send_initial=False)
+                clients.append(c)
+            counts["encodes"] = counts["calls"] = 0
+            for i in range(10):
+                api.create(_cm(f"c{i}"))
+            assert _wait(lambda: all(s[0] == 10 for s in seen)), seen
+            # 10 events × 3 subscribers: ≥30 raw() fan-out calls but
+            # exactly 10 serializations
+            assert counts["encodes"] == 10, counts
+            assert counts["calls"] >= 30, counts
+        finally:
+            for c in clients:
+                c.close()
+            srv.stop()
+
+    def test_batch_splice_produces_equivalent_json(self):
+        """The watch_batch byte-splice must decode to exactly what the
+        old per-entry re-encode produced."""
+        import json as _json
+
+        from volcano_tpu.bus.server import _CachedPayload, _splice_watch_id
+
+        entry = {"seq": 42, "kind": "Pod", "event": "ADDED",
+                 "old": None, "new": {"kind": "Pod", "metadata": {}},
+                 "ts": 1.5}
+        cached = _CachedPayload(entry)
+        spliced = _splice_watch_id(cached.raw(), 7)
+        assert _json.loads(spliced) == dict(entry, watch_id=7)

@@ -377,3 +377,56 @@ def test_rendered_scheduler_command_parses():
     assert args.scheduler_conf.startswith(mount["mountPath"] + "/")
     # probe port agrees with the port the process actually binds
     assert container["livenessProbe"]["httpGet"]["port"] == args.listen_port
+
+
+class TestShardedFederationRendering:
+    def test_shards_renders_pinned_members_no_leader_election(self):
+        values = apply_set(DEFAULT_VALUES, "scheduler.shards=3")
+        manifests = dict(render(values))
+        # the leader-elected pair is REPLACED by three pinned members
+        assert "30-scheduler-deployment.yaml" not in manifests
+        for i in range(3):
+            dep = manifests[f"30-scheduler-{i}-deployment.yaml"]
+            assert dep["spec"]["replicas"] == 1
+            cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
+            assert "--leader-elect" not in cmd
+            assert cmd[cmd.index("--shards") + 1] == "3"
+            assert (
+                cmd[cmd.index("--shard-identity") + 1]
+                == f"volcano-tpu-scheduler-{i}"
+            )
+            assert "--shard-lease-duration" in cmd
+            # every member still carries the compute-plane sidecar
+            names = [c["name"] for c in
+                     dep["spec"]["template"]["spec"]["containers"]]
+            assert names == ["scheduler", "compute-plane"]
+
+    def test_shard_member_commands_parse(self):
+        import argparse
+
+        from volcano_tpu.cmd.scheduler import add_common_args
+
+        values = apply_set(DEFAULT_VALUES, "scheduler.shards=2")
+        dep = dict(render(values))["30-scheduler-1-deployment.yaml"]
+        cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
+        parser = argparse.ArgumentParser()
+        parser.add_argument("--scheduler-conf", default="")
+        parser.add_argument("--micro-cycles", action="store_true")
+        parser.add_argument("--shards", type=int, default=0)
+        parser.add_argument("--shard-identity", default="")
+        parser.add_argument("--shard-lease-duration", type=float,
+                            default=2.0)
+        add_common_args(parser)
+        args = parser.parse_args(cmd[1:])
+        assert args.shards == 2
+        assert args.shard_identity == "volcano-tpu-scheduler-1"
+        assert args.bus == BUS_URL
+
+    def test_shards_off_output_unchanged(self):
+        # shards=0 (the default) must render exactly the classic
+        # topology — the pinned static manifest stays valid
+        assert dict(render(DEFAULT_VALUES)).keys() == dict(
+            render(apply_set(DEFAULT_VALUES, "scheduler.shards=0"))
+        ).keys()
+        assert "30-scheduler-deployment.yaml" in dict(
+            render(DEFAULT_VALUES))

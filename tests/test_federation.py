@@ -1,0 +1,924 @@
+"""Sharded scheduler federation (ISSUE 9).
+
+Covers the four load-bearing claims:
+
+* **Leases** — claim/renew/absorb-on-expiry/release-on-join over the
+  CAS shard map; a crashed member's slices are re-owned within one
+  lease TTL.
+* **Filtering** — each member's cache holds only its owned slice
+  (O(nodes/N)); foreign pods bound onto owned nodes are accounted but
+  never scheduled; ownership moves replay state correctly.
+* **Spillover** — home-shard-stuck tasks CAS-bind onto foreign nodes;
+  conflicts resolve at the store; gang semantics stay within home
+  shards.
+* **Equivalence** — ``--shards 1`` is bit-identical to the plain
+  scheduler (binding maps + ``trace.replay.verify``); multi-shard runs
+  pass the policy-equivalence checker.
+
+The tier-1 chaos smoke runs three federated members over a real TCP
+bus and SIGKILLs one mid-cycle via the deterministic fault plane
+(``shard.kill``); the soak variant is marked ``slow``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+
+import pytest
+
+from volcano_tpu import faults, trace
+from volcano_tpu.bus.remote import RemoteAPIServer
+from volcano_tpu.bus.server import BusServer
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.client import (
+    ADDED,
+    APIServer,
+    KubeClient,
+    MODIFIED,
+    SchedulerClient,
+    VolcanoClient,
+)
+from volcano_tpu.client.apiserver import ConflictError
+from volcano_tpu.federation import (
+    FederatedScheduler,
+    read_shard_map,
+    verify_federation,
+)
+from volcano_tpu.federation.filter import ShardInformerFilter
+from volcano_tpu.federation.leases import ShardLeaseManager
+from volcano_tpu.federation.sharding import (
+    home_shard,
+    shard_of_node,
+    ShardState,
+)
+from volcano_tpu.scheduler.scheduler import Scheduler
+
+from tests.builders import build_node, build_pod, build_pod_group, build_queue
+
+CONF = """
+actions: "enqueue, jax-allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.configure(None)
+    yield
+    faults.configure(None)
+    trace.disable()
+
+
+def _wait(pred, timeout=20.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _conf(tmp_path, name="conf"):
+    p = tmp_path / f"{name}.yaml"
+    p.write_text(CONF)
+    return str(p)
+
+
+def _names_for_shard(shard: int, n_shards: int, count: int, prefix="job"):
+    """Job names whose home shard is exactly ``shard`` (deterministic
+    search over the hash)."""
+    out, k = [], 0
+    while len(out) < count:
+        name = f"{prefix}{k}"
+        k += 1
+        if home_shard("ns", name, n_shards) == shard:
+            out.append(name)
+    return out
+
+
+class TestSharding:
+    def test_deterministic_and_in_range(self):
+        for n in (1, 2, 4, 7):
+            for i in range(64):
+                s = shard_of_node(f"node-{i}", n)
+                assert 0 <= s < n
+                assert s == shard_of_node(f"node-{i}", n)
+                h = home_shard("ns", f"job-{i}", n)
+                assert 0 <= h < n
+
+    def test_single_shard_collapses_to_zero(self):
+        assert shard_of_node("anything", 1) == 0
+        assert home_shard("ns", "job", 1) == 0
+
+    def test_spreads_across_shards(self):
+        hits = {shard_of_node(f"n{i:04d}", 4) for i in range(64)}
+        assert hits == {0, 1, 2, 3}
+
+
+class TestShardLeases:
+    def test_two_members_split_the_map(self):
+        api = APIServer()
+        owned = {0: set(), 1: set()}
+        mgrs = [
+            ShardLeaseManager(
+                api, f"m{i}", 4, lease_duration=0.6, retry_period=0.03,
+                on_acquire=owned[i].add, on_release=owned[i].discard,
+            ).start()
+            for i in range(2)
+        ]
+        try:
+            assert _wait(
+                lambda: len(owned[0]) == 2 and len(owned[1]) == 2
+                and owned[0] | owned[1] == {0, 1, 2, 3}
+                and not (owned[0] & owned[1])
+            ), f"never balanced: {owned}"
+            rec = read_shard_map(api)
+            assert set(rec["members"]) == {"m0", "m1"}
+        finally:
+            for m in mgrs:
+                m.stop()
+
+    def test_crash_absorbed_within_one_ttl(self):
+        api = APIServer()
+        ttl = 0.5
+        owned = {i: set() for i in range(3)}
+        mgrs = [
+            ShardLeaseManager(
+                api, f"m{i}", 3, lease_duration=ttl, retry_period=0.03,
+                on_acquire=owned[i].add, on_release=owned[i].discard,
+            ).start()
+            for i in range(3)
+        ]
+        try:
+            assert _wait(
+                lambda: all(len(owned[i]) == 1 for i in range(3))
+            ), f"never settled 1:1:1: {owned}"
+            victim = next(
+                i for i in range(3)
+                if read_shard_map(api)["shards"]["0"]["holder"] == f"m{i}"
+            )
+            mgrs[victim].stop(release=False)  # crash: lease left to expire
+            t0 = time.monotonic()
+            survivors = [i for i in range(3) if i != victim]
+            assert _wait(
+                lambda: owned[survivors[0]] | owned[survivors[1]]
+                == {0, 1, 2},
+                timeout=ttl * 4 + 2.0,
+            ), f"orphaned shard never absorbed: {owned}"
+            # absorbed within one TTL of the lease EXPIRING (the lease
+            # was still valid when the crash happened)
+            assert time.monotonic() - t0 <= ttl + ttl + 1.0
+        finally:
+            for m in mgrs:
+                m.stop()
+
+    def test_joiner_gets_a_released_share(self):
+        api = APIServer()
+        first, second = set(), set()
+        m0 = ShardLeaseManager(
+            api, "m0", 4, lease_duration=0.6, retry_period=0.03,
+            on_acquire=first.add, on_release=first.discard,
+        ).start()
+        try:
+            assert _wait(lambda: first == {0, 1, 2, 3})
+            m1 = ShardLeaseManager(
+                api, "m1", 4, lease_duration=0.6, retry_period=0.03,
+                on_acquire=second.add, on_release=second.discard,
+            ).start()
+            try:
+                assert _wait(
+                    lambda: len(first) == 2 and len(second) == 2
+                ), f"join never rebalanced: {first} {second}"
+            finally:
+                m1.stop()
+        finally:
+            m0.stop()
+
+    def test_nshards_mismatch_refuses_to_participate(self):
+        api = APIServer()
+        good = set()
+        m0 = ShardLeaseManager(
+            api, "m0", 2, lease_duration=0.6, retry_period=0.03,
+            on_acquire=good.add, on_release=good.discard,
+        ).start()
+        try:
+            assert _wait(lambda: good == {0, 1})
+            bad = set()
+            m1 = ShardLeaseManager(
+                api, "m1", 3, lease_duration=0.6, retry_period=0.03,
+                on_acquire=bad.add, on_release=bad.discard,
+            ).start()
+            try:
+                time.sleep(0.4)
+                assert bad == set()  # never claimed against a 2-shard map
+                rec = read_shard_map(api)
+                assert int(rec["nShards"]) == 2
+            finally:
+                m1.stop()
+        finally:
+            m0.stop()
+
+    def test_graceful_stop_releases_immediately(self):
+        api = APIServer()
+        owned = set()
+        m = ShardLeaseManager(
+            api, "m0", 2, lease_duration=5.0, retry_period=0.03,
+            on_acquire=owned.add, on_release=owned.discard,
+        ).start()
+        assert _wait(lambda: owned == {0, 1})
+        m.stop(release=True)
+        rec = read_shard_map(api)
+        assert all(
+            not e.get("holder") for e in rec["shards"].values()
+        ), rec["shards"]
+        assert "m0" not in rec.get("members", {})
+
+
+class _FilterRig:
+    """Cache + state + filter, no lease manager — ownership flipped by
+    hand so the forwarding rules are tested in isolation."""
+
+    def __init__(self, n_shards=2, api=None):
+        self.api = api or APIServer()
+        self.cache = SchedulerCache(
+            client=SchedulerClient(self.api), scheduler_name="volcano-tpu"
+        )
+        self.state = ShardState(n_shards)
+        self.filter = ShardInformerFilter(
+            self.cache, self.state, lister=self.api
+        )
+        self.cache.set_informer_sink(self.filter)
+        self.cache.run()
+
+    def own(self, shard):
+        self.state.acquire(shard)
+        self.filter.on_acquire(shard)
+
+    def disown(self, shard):
+        self.state.release(shard)
+        self.filter.on_release(shard)
+
+
+def _nodes_for_shard(shard, n_shards, count, cpu="8"):
+    out, k = [], 0
+    while len(out) < count:
+        name = f"n{k:03d}"
+        k += 1
+        if shard_of_node(name, n_shards) == shard:
+            out.append(build_node(name, {"cpu": cpu, "memory": "64Gi"}))
+    return out
+
+
+class TestShardFilter:
+    def test_cache_holds_only_owned_nodes(self):
+        rig = _FilterRig()
+        rig.own(0)
+        kube = KubeClient(rig.api)
+        for shard in (0, 1):
+            for node in _nodes_for_shard(shard, 2, 3):
+                kube.create_node(node)
+        owned = {
+            n for n in rig.cache.nodes if shard_of_node(n, 2) == 0
+        }
+        assert set(rig.cache.nodes) == owned and len(owned) == 3
+
+    def test_foreign_bound_pod_is_accounting_only(self):
+        rig = _FilterRig()
+        rig.own(0)
+        kube = KubeClient(rig.api)
+        vc = VolcanoClient(rig.api)
+        vc.create_queue(build_queue("default"))
+        node = _nodes_for_shard(0, 2, 1)[0]
+        kube.create_node(node)
+        # a job homed on shard 1 (foreign) whose pod lands on OUR node
+        # — another member's spillover, observed through the watch
+        jname = _names_for_shard(1, 2, 1)[0]
+        vc.create_pod_group(build_pod_group("ns", jname, 1))
+        kube.create_pod(build_pod(
+            "ns", f"{jname}-t0", node.metadata.name,
+            {"cpu": "1", "memory": "1Gi"}, group=jname,
+        ))
+        ninfo = rig.cache.nodes[node.metadata.name]
+        assert len(ninfo.tasks) == 1  # node accounting present
+        job = rig.cache.jobs.get(f"ns/{jname}")
+        assert job is not None and job.pod_group is None  # inert: the
+        # foreign PodGroup was filtered, so snapshots never schedule it
+        assert not rig.cache.has_schedulable_pending()
+
+    def test_acquire_replays_and_release_drops(self):
+        rig = _FilterRig()
+        rig.own(0)
+        kube = KubeClient(rig.api)
+        vc = VolcanoClient(rig.api)
+        vc.create_queue(build_queue("default"))
+        for shard in (0, 1):
+            for node in _nodes_for_shard(shard, 2, 2):
+                kube.create_node(node)
+        jname = _names_for_shard(1, 2, 1)[0]
+        vc.create_pod_group(build_pod_group("ns", jname, 1))
+        kube.create_pod(build_pod(
+            "ns", f"{jname}-t0", "", {"cpu": "1", "memory": "1Gi"},
+            group=jname,
+        ))
+        assert f"ns/{jname}" not in rig.cache.jobs
+        assert len(rig.cache.nodes) == 2
+        rig.own(1)  # absorb: relist must deliver shard 1's world
+        assert len(rig.cache.nodes) == 4
+        job = rig.cache.jobs[f"ns/{jname}"]
+        assert job.pod_group is not None and len(job.tasks) == 1
+        assert rig.cache.has_schedulable_pending()
+        rig.disown(1)  # shed it again
+        assert len(rig.cache.nodes) == 2
+        assert f"ns/{jname}" not in rig.cache.jobs
+
+    def test_single_shard_passes_everything(self):
+        rig = _FilterRig(n_shards=1)
+        rig.own(0)
+        kube = KubeClient(rig.api)
+        for i in range(5):
+            kube.create_node(build_node(f"x{i}", {"cpu": "4",
+                                                  "memory": "8Gi"}))
+        assert len(rig.cache.nodes) == 5
+
+
+class TestCasBind:
+    def test_cas_bind_binds_once(self):
+        api = APIServer()
+        kube = KubeClient(api)
+        kube.create_node(build_node("n1", {"cpu": "4", "memory": "8Gi"}))
+        pod = kube.create_pod(build_pod("ns", "p1", "",
+                                        {"cpu": "1", "memory": "1Gi"}))
+        bound = api.cas_bind("ns", "p1", "n1",
+                             expected_rv=pod.metadata.resource_version)
+        assert bound.spec.node_name == "n1"
+        with pytest.raises(ConflictError):
+            api.cas_bind("ns", "p1", "n2")
+
+    def test_cas_bind_detects_rv_race(self):
+        api = APIServer()
+        kube = KubeClient(api)
+        pod = kube.create_pod(build_pod("ns", "p1", "",
+                                        {"cpu": "1", "memory": "1Gi"}))
+        stale = pod.metadata.resource_version
+        pod.metadata.labels["touched"] = "yes"
+        api.update(pod)  # rv moves
+        with pytest.raises(ConflictError):
+            api.cas_bind("ns", "p1", "n1", expected_rv=stale)
+
+
+def _make_fed(api, ident, n_shards, conf, ttl=0.8, spill_after=1):
+    fed = FederatedScheduler(
+        api, ident, n_shards, scheduler_conf_path=conf,
+        lease_duration=ttl, lease_retry_period=0.04,
+        spill_after=spill_after,
+    )
+    return fed.start()
+
+
+class TestSpillover:
+    def test_home_shard_full_spills_to_foreign(self, tmp_path):
+        api = APIServer()
+        kube, vc = KubeClient(api), VolcanoClient(api)
+        vc.create_queue(build_queue("default"))
+        # shard 1 nodes are tiny; shard 0 nodes have room
+        for node in _nodes_for_shard(0, 2, 3, cpu="16"):
+            kube.create_node(node)
+        for node in _nodes_for_shard(1, 2, 3, cpu="1"):
+            kube.create_node(node)
+        feds = [
+            _make_fed(api, f"s{i}", 2, _conf(tmp_path)) for i in range(2)
+        ]
+        try:
+            for f in feds:
+                assert f.wait_owned(10.0)
+            _wait(lambda: sum(len(f.state.owned()) for f in feds) == 2)
+            spiller = next(f for f in feds if f.state.owns_shard(1))
+            for jname in _names_for_shard(1, 2, 3, prefix="big"):
+                vc.create_pod_group(build_pod_group("ns", jname, 1))
+                kube.create_pod(build_pod(
+                    "ns", f"{jname}-t0", "",
+                    {"cpu": "2", "memory": "1Gi"}, group=jname,
+                ))
+
+            def all_bound():
+                for f in feds:
+                    f.scheduler.run_once()
+                return all(
+                    p.spec.node_name for p in kube.list_pods("ns")
+                )
+
+            assert _wait(all_bound, timeout=30.0, interval=0.05)
+            for p in kube.list_pods("ns"):
+                assert shard_of_node(p.spec.node_name, 2) == 0, (
+                    "spill landed on the full home shard?!"
+                )
+            assert spiller.spillover.counters().get("bound", 0) == 3
+            report = verify_federation(api, 2)
+            assert report["ok"], report["violations"]
+        finally:
+            for f in feds:
+                f.stop()
+
+    def test_unsatisfied_gang_never_spills(self, tmp_path):
+        api = APIServer()
+        kube, vc = KubeClient(api), VolcanoClient(api)
+        vc.create_queue(build_queue("default"))
+        for node in _nodes_for_shard(0, 2, 3, cpu="16"):
+            kube.create_node(node)
+        for node in _nodes_for_shard(1, 2, 1, cpu="1"):
+            kube.create_node(node)
+        feds = [
+            _make_fed(api, f"s{i}", 2, _conf(tmp_path)) for i in range(2)
+        ]
+        try:
+            for f in feds:
+                assert f.wait_owned(10.0)
+            _wait(lambda: sum(len(f.state.owned()) for f in feds) == 2)
+            # a gang of 3 homed on the tiny shard: it can never reach
+            # minMember at home, and spillover must NOT assemble it
+            # across shards
+            jname = _names_for_shard(1, 2, 1, prefix="gang")[0]
+            vc.create_pod_group(build_pod_group("ns", jname, 3))
+            for i in range(3):
+                kube.create_pod(build_pod(
+                    "ns", f"{jname}-t{i}", "",
+                    {"cpu": "2", "memory": "1Gi"}, group=jname,
+                ))
+            for _ in range(6):
+                for f in feds:
+                    f.scheduler.run_once()
+                time.sleep(0.02)
+            assert all(
+                not p.spec.node_name for p in kube.list_pods("ns")
+            ), "gang task escaped its home shard below minMember"
+            spiller = next(f for f in feds if f.state.owns_shard(1))
+            assert spiller.spillover.counters().get("bound", 0) == 0
+        finally:
+            for f in feds:
+                f.stop()
+
+    def test_lost_race_is_detected_at_the_store(self, tmp_path):
+        api = APIServer()
+        kube, vc = KubeClient(api), VolcanoClient(api)
+        vc.create_queue(build_queue("default"))
+        for node in _nodes_for_shard(0, 2, 2, cpu="16"):
+            kube.create_node(node)
+        for node in _nodes_for_shard(1, 2, 1, cpu="1"):
+            kube.create_node(node)
+        feds = [
+            _make_fed(api, f"s{i}", 2, _conf(tmp_path)) for i in range(2)
+        ]
+        try:
+            for f in feds:
+                assert f.wait_owned(10.0)
+            _wait(lambda: sum(len(f.state.owned()) for f in feds) == 2)
+            spiller = next(f for f in feds if f.state.owns_shard(1))
+            jname = _names_for_shard(1, 2, 1, prefix="race")[0]
+            vc.create_pod_group(build_pod_group("ns", jname, 1))
+            kube.create_pod(build_pod(
+                "ns", f"{jname}-t0", "", {"cpu": "2", "memory": "1Gi"},
+                group=jname,
+            ))
+            spiller.scheduler.run_once()
+            task = spiller.cache.pending_spill_view()[0]["tasks"][0]
+            # another scheduler wins the pod at the store an instant
+            # before our spill pass acts on its (now stale) view
+            foreign = next(
+                n.metadata.name for n in api.list("Node")
+                if shard_of_node(n.metadata.name, 2) == 0
+            )
+            api.cas_bind("ns", f"{jname}-t0", foreign)
+            assert spiller.spillover._spill_one(task) is False
+            c = spiller.spillover.counters()
+            assert c.get("bound", 0) == 0
+            assert c.get("lost-race", 0) == 1
+        finally:
+            for f in feds:
+                f.stop()
+
+
+class TestSingleShardEquivalence:
+    WORKLOAD = (("a", 3), ("b", 2), ("c", 4))
+
+    def _seed(self, api):
+        kube, vc = KubeClient(api), VolcanoClient(api)
+        vc.create_queue(build_queue("default"))
+        for i in range(6):
+            kube.create_node(build_node(
+                f"n{i}", {"cpu": "8", "memory": "64Gi"},
+                labels={"slot": f"s{i}"},
+            ))
+        for name, replicas in self.WORKLOAD:
+            vc.create_pod_group(build_pod_group("ns", name, replicas))
+            for i in range(replicas):
+                kube.create_pod(build_pod(
+                    "ns", f"{name}-t{i}", "",
+                    {"cpu": "1", "memory": "1Gi"}, group=name,
+                    selector={"slot": f"s{(i * 2) % 6}"},
+                ))
+        return kube
+
+    def test_shards_1_bindings_bit_identical(self, tmp_path):
+        # plain scheduler
+        api_plain = APIServer()
+        kube_plain = self._seed(api_plain)
+        cache = SchedulerCache(
+            client=SchedulerClient(api_plain), scheduler_name="volcano-tpu"
+        )
+        sched = Scheduler(cache, scheduler_conf_path=_conf(tmp_path))
+        cache.run()
+        for _ in range(3):
+            sched.run_once()
+        plain = {
+            p.metadata.name: p.spec.node_name
+            for p in kube_plain.list_pods("ns")
+        }
+        assert all(plain.values()), plain
+
+        # single-shard federation over an identical store
+        api_fed = APIServer()
+        kube_fed = self._seed(api_fed)
+        fed = _make_fed(api_fed, "solo", 1, _conf(tmp_path, "fedconf"))
+        try:
+            assert fed.wait_owned(10.0)
+            for _ in range(3):
+                fed.scheduler.run_once()
+            feder = {
+                p.metadata.name: p.spec.node_name
+                for p in kube_fed.list_pods("ns")
+            }
+        finally:
+            fed.stop()
+        assert feder == plain
+
+    def test_shards_1_replay_verifies(self, tmp_path):
+        """trace.replay.verify over a cycle recorded INSIDE single-shard
+        federation mode: replaying the captured packed session through
+        the kernel reproduces the recorded bindings exactly — federation
+        plumbing adds nothing to the device path."""
+        jdir = str(tmp_path / "journal")
+        api = APIServer()
+        self._seed(api)
+        trace.enable(jdir, snapshot_every=1)
+        fed = _make_fed(api, "solo", 1, _conf(tmp_path))
+        try:
+            assert fed.wait_owned(10.0)
+            fed.scheduler.run_once()
+        finally:
+            fed.stop()
+            trace.disable()
+        result = trace.replay.verify(jdir, executor="jax")
+        assert result.match, result.summary()
+
+
+class FederationCluster:
+    """Three federated members over one real TCP bus, with a
+    store-truth audit watch (dup-bind detection) — the ChaosCluster
+    pattern, federated."""
+
+    def __init__(self, tmp_path, name, n_shards=3, n_nodes=9,
+                 node_cpu="4", ttl=0.8):
+        self.api = APIServer()
+        self.bus = BusServer(self.api).start()
+        self.kube = KubeClient(self.api)
+        self.vc = VolcanoClient(self.api)
+        self.vc.create_queue(build_queue("default"))
+        self.n_shards = n_shards
+        self.ttl = ttl
+        made, k = 0, 0
+        while made < n_nodes:
+            nname = f"n{k:03d}"
+            k += 1
+            self.kube.create_node(build_node(
+                nname, {"cpu": node_cpu, "memory": "64Gi"}
+            ))
+            made += 1
+        self.bound = {}
+        self.rebinds = []
+        self.api.watch("Pod", self._audit, send_initial=False)
+        conf = tmp_path / f"{name}-conf.yaml"
+        conf.write_text(CONF)
+        self.remotes = []
+        self.feds = []
+        for i in range(n_shards):
+            remote = RemoteAPIServer(
+                f"tcp://127.0.0.1:{self.bus.port}", timeout=5.0
+            )
+            assert remote.wait_ready(10.0)
+            self.remotes.append(remote)
+            fed = FederatedScheduler(
+                remote, f"m{i}", n_shards,
+                scheduler_conf_path=str(conf),
+                lease_duration=ttl, lease_retry_period=0.04,
+                spill_after=1,
+            ).start()
+            self.feds.append(fed)
+
+    def _audit(self, event, old, new):
+        if event not in (ADDED, MODIFIED) or new is None:
+            return
+        if not new.spec.node_name:
+            return
+        key = f"{new.metadata.namespace}/{new.metadata.name}"
+        prev = self.bound.get(key)
+        if prev is None:
+            self.bound[key] = new.spec.node_name
+        elif prev != new.spec.node_name:
+            self.rebinds.append((key, prev, new.spec.node_name))
+
+    def submit(self, name, replicas=1, cpu="1", min_member=None):
+        self.vc.create_pod_group(build_pod_group(
+            "ns", name, replicas if min_member is None else min_member
+        ))
+        for i in range(replicas):
+            self.kube.create_pod(build_pod(
+                "ns", f"{name}-t{i}", "", {"cpu": cpu, "memory": "1Gi"},
+                group=name,
+            ))
+
+    def cycle(self):
+        for fed in self.feds:
+            if fed._crashed:
+                continue
+            try:
+                fed.scheduler.run_once()
+            except Exception:  # noqa: BLE001 — daemon loops log + retry
+                pass
+
+    def all_placed(self):
+        pods = self.kube.list_pods("ns")
+        return bool(pods) and all(p.spec.node_name for p in pods)
+
+    def live_holders(self):
+        rec = read_shard_map(self.api) or {}
+        now = time.time()
+        out = {}
+        for i, e in rec.get("shards", {}).items():
+            holder = e.get("holder") or ""
+            expired = now - float(e.get("renewTime", 0.0)) > float(
+                e.get("leaseDurationSeconds", 0.0) or 0.0
+            )
+            out[i] = holder if holder and not expired else None
+        return out
+
+    def close(self):
+        for fed in self.feds:
+            fed.stop()
+        for remote in self.remotes:
+            remote.close()
+        self.bus.stop()
+
+
+class TestFederationChaosSmoke:
+    def test_shard_kill_rebalances_no_dup_no_loss(self, tmp_path):
+        """Tier-1 acceptance: SIGKILL one of three federated schedulers
+        mid-cycle via the fault plane (``shard.kill``); the orphaned
+        slices are re-owned within one lease TTL, every job still binds
+        exactly once, and the run is policy-equivalent."""
+        cluster = FederationCluster(tmp_path, "kill", ttl=0.8)
+        try:
+            for fed in cluster.feds:
+                assert fed.wait_owned(15.0)
+            assert _wait(
+                lambda: sum(
+                    len(f.state.owned()) for f in cluster.feds
+                ) == 3,
+                timeout=10.0,
+            )
+            for i in range(6):
+                cluster.submit(f"pre{i}", replicas=1)
+            assert _wait(
+                lambda: (cluster.cycle() or True) and cluster.all_placed(),
+                timeout=30.0, interval=0.05,
+            )
+            # the deterministic kill: first post-cycle evaluation fires
+            faults.configure("seed=9;shard.kill=1:count=1")
+            cluster.cycle()
+            faults.configure(None)
+            dead = [f for f in cluster.feds if f._crashed]
+            assert len(dead) == 1, "shard.kill should take exactly one"
+            dead_ident = dead[0].identity
+            expire_by = time.monotonic() + cluster.ttl
+            # work submitted while the member is down — its home-shard
+            # jobs must be absorbed along with its nodes
+            for i in range(6):
+                cluster.submit(f"post{i}", replicas=1)
+            # orphaned slices re-owned within one TTL of lease expiry
+            assert _wait(
+                lambda: (cluster.cycle() or True) and all(
+                    h is not None and h != dead_ident
+                    for h in cluster.live_holders().values()
+                ),
+                timeout=cluster.ttl * 2 + 3.0, interval=0.05,
+            ), f"holders: {cluster.live_holders()}"
+            absorb_lag = time.monotonic() - expire_by
+            assert absorb_lag <= cluster.ttl + 1.0, (
+                f"absorb took {absorb_lag:.2f}s past expiry "
+                f"(TTL {cluster.ttl}s)"
+            )
+            assert _wait(
+                lambda: (cluster.cycle() or True) and cluster.all_placed(),
+                timeout=30.0, interval=0.05,
+            ), "jobs lost after shard kill"
+            assert cluster.rebinds == [], (
+                f"duplicate binds: {cluster.rebinds}"
+            )
+            assert len(cluster.bound) == 12  # zero lost
+            report = verify_federation(cluster.api, cluster.n_shards)
+            assert report["ok"], report["violations"]
+            # survivors really did absorb: the dead member's cache slice
+            # now lives in a survivor
+            survivor_nodes = set()
+            for fed in cluster.feds:
+                if not fed._crashed:
+                    survivor_nodes |= set(fed.cache.nodes)
+            assert len(survivor_nodes) == 9
+        finally:
+            cluster.close()
+
+
+@pytest.mark.slow
+class TestFederationSoak:
+    def test_rolling_kills_and_rejoins(self, tmp_path):
+        """Slow soak: rolling workload over a 3-member federation while
+        members are killed and replaced; ends converged, no dup binds,
+        policy-equivalent."""
+        cluster = FederationCluster(tmp_path, "soak", ttl=0.6)
+        conf = str(tmp_path / "soak-conf.yaml")
+        try:
+            for fed in cluster.feds:
+                assert fed.wait_owned(15.0)
+            submitted = 0
+            for round_i in range(3):
+                for j in range(4):
+                    # min_member=1: a home shard that fills up must be
+                    # escapable via spillover, and gangs deliberately
+                    # never spill below their minimum (the known-gaps
+                    # restriction) — a full-shard gang would starve by
+                    # design, which is not what this soak probes
+                    cluster.submit(f"r{round_i}x{j}", replicas=2,
+                                   min_member=1)
+                    submitted += 2
+                assert _wait(
+                    lambda: (cluster.cycle() or True)
+                    and cluster.all_placed(),
+                    timeout=40.0, interval=0.05,
+                ), f"round {round_i} never converged"
+                victim = round_i % 3
+                cluster.feds[victim].crash()
+                assert _wait(
+                    lambda: (cluster.cycle() or True) and all(
+                        h is not None
+                        for h in cluster.live_holders().values()
+                    ),
+                    timeout=cluster.ttl * 3 + 3.0, interval=0.05,
+                )
+                # replacement member joins under a fresh identity
+                remote = RemoteAPIServer(
+                    f"tcp://127.0.0.1:{cluster.bus.port}", timeout=5.0
+                )
+                assert remote.wait_ready(10.0)
+                cluster.remotes.append(remote)
+                fed = FederatedScheduler(
+                    remote, f"m{3 + round_i}", cluster.n_shards,
+                    scheduler_conf_path=conf,
+                    lease_duration=cluster.ttl, lease_retry_period=0.04,
+                    spill_after=1,
+                ).start()
+                cluster.feds[victim] = fed
+                assert fed.wait_owned(15.0)
+            assert _wait(
+                lambda: (cluster.cycle() or True) and cluster.all_placed(),
+                timeout=40.0, interval=0.05,
+            )
+            assert cluster.rebinds == []
+            assert len(cluster.bound) == submitted
+            report = verify_federation(cluster.api, cluster.n_shards)
+            assert report["ok"], report["violations"]
+        finally:
+            cluster.close()
+
+
+class TestVtctlShards:
+    def test_shards_output_byte_identical_over_backends(self, tmp_path):
+        """`vtctl shards` renders from the shard-map ConfigMap alone, so
+        the same store state renders identically in-process and over
+        --bus."""
+        import io
+        import json as _json
+
+        from volcano_tpu.apis import core
+        from volcano_tpu.cli.vtctl import main as vtctl_main
+        from volcano_tpu.federation.leases import (
+            SHARD_MAP_KEY,
+            SHARD_MAP_NAME,
+        )
+
+        api = APIServer()
+        rec = {
+            "nShards": 2,
+            "members": {"m0": {"heartbeat": 1000.0,
+                               "leaseDurationSeconds": 2.0}},
+            "shards": {
+                "0": {"holder": "m0", "renewTime": 1000.0,
+                      "leaseDurationSeconds": 2.0},
+                "1": {"holder": "", "renewTime": 0.0,
+                      "leaseDurationSeconds": 2.0},
+            },
+            "stats": {"m0": {"nodesOwned": 4, "rebalances": 1,
+                             "spillover": {"bound": 2, "conflict": 1}}},
+        }
+        api.create(core.ConfigMap(
+            metadata=core.ObjectMeta(name=SHARD_MAP_NAME,
+                                     namespace="volcano-system"),
+            data={SHARD_MAP_KEY: _json.dumps(rec)},
+        ))
+        direct = io.StringIO()
+        assert vtctl_main(["shards"], api=api, out=direct) == 0
+        bus = BusServer(api).start()
+        try:
+            remote = io.StringIO()
+            assert vtctl_main(
+                ["--bus", f"tcp://127.0.0.1:{bus.port}", "shards"],
+                out=remote,
+            ) == 0
+        finally:
+            bus.stop()
+        assert direct.getvalue() == remote.getvalue()
+        assert "m0" in direct.getvalue()
+        assert "<unheld>" in direct.getvalue()
+
+    def test_shards_without_map(self):
+        import io
+
+        from volcano_tpu.cli.vtctl import main as vtctl_main
+
+        out = io.StringIO()
+        assert vtctl_main(["shards"], api=APIServer(), out=out) == 1
+        assert "no shard map" in out.getvalue()
+
+
+class TestPolicyChecker:
+    def test_flags_overcommit_and_partial_gang(self):
+        api = APIServer()
+        kube, vc = KubeClient(api), VolcanoClient(api)
+        kube.create_node(build_node("n1", {"cpu": "2", "memory": "4Gi"}))
+        # overcommit: two 2-cpu pods on a 2-cpu node
+        for i in range(2):
+            kube.create_pod(build_pod(
+                "ns", f"o{i}", "n1", {"cpu": "2", "memory": "1Gi"},
+            ))
+        # partial gang: 1 of 3 bound, 2 pending
+        vc.create_pod_group(build_pod_group("ns", "g", 3))
+        kube.create_pod(build_pod(
+            "ns", "g-t0", "n1", {"cpu": "0", "memory": "0"}, group="g"))
+        for i in (1, 2):
+            kube.create_pod(build_pod(
+                "ns", f"g-t{i}", "", {"cpu": "0", "memory": "0"},
+                group="g"))
+        report = verify_federation(api, 2)
+        assert not report["ok"]
+        kinds = "\n".join(report["violations"])
+        assert "overcommitted" in kinds
+        assert "partially placed" in kinds
+
+    def test_clean_store_passes(self):
+        api = APIServer()
+        kube = KubeClient(api)
+        kube.create_node(build_node("n1", {"cpu": "4", "memory": "8Gi"}))
+        kube.create_pod(build_pod(
+            "ns", "p", "n1", {"cpu": "1", "memory": "1Gi"}))
+        assert verify_federation(api, 1)["ok"]
+
+
+class TestSpilloverLedgerAccounting:
+    def test_ledger_tracks_bound_and_released_capacity(self):
+        state = ShardState(2)
+        state.acquire(0)
+        cache = SchedulerCache(scheduler_name="volcano-tpu")
+        filt = ShardInformerFilter(cache, state)
+        foreign = _nodes_for_shard(1, 2, 1, cpu="4")[0]
+        filt.add_node(foreign)
+        pod = build_pod("ns", "p1", foreign.metadata.name,
+                        {"cpu": "3", "memory": "1Gi"})
+        filt.add_pod(pod)
+        # 3 of 4 cpus used: a 2-cpu task no longer fits
+        from volcano_tpu.api.job_info import new_task_info
+
+        big = new_task_info(build_pod("ns", "want", "",
+                                      {"cpu": "2", "memory": "1Gi"}))
+        assert filt.spill_candidates(big) == []
+        done = pod.clone()
+        done.status.phase = "Succeeded"
+        filt.update_pod(pod, done)
+        assert filt.spill_candidates(big) == [foreign.metadata.name]

@@ -301,7 +301,11 @@ class TestFullCycleRouting:
             assert _wait(lambda: _counter(
                 "full_cycle_fallbacks_total", cause="gang-arrival"
             ) > before)
-            assert cluster.scheduler.full_cycles_run > fulls0
+            # polled, not asserted flat: binds land at store truth (and
+            # the fallback counter registers at window start) while
+            # run_once is still closing the session — full_cycles_run
+            # increments only after the cycle returns
+            assert _wait(lambda: cluster.scheduler.full_cycles_run > fulls0)
         finally:
             cluster.close()
 

@@ -134,6 +134,21 @@ class TaskInfo:
         )
 
 
+def pod_request_resource(pod: core.Pod) -> Resource:
+    """Summed container requests (the reference's GetPodResourceRequest
+    without the init-container max — i.e. exactly what NodeInfo
+    accounting charges per held task).  The single copy shared by
+    new_task_info, the federation spill ledger, and the federation
+    policy checker, so spill candidate selection and equivalence
+    verification can never drift from the scheduler's own node
+    accounting.  Init containers are deliberately excluded: the running
+    steady state is what node Used/Idle tracks."""
+    resreq = Resource()
+    for c in pod.spec.containers:
+        resreq.add(Resource.from_resource_list(c.resources.get("requests") or {}))
+    return resreq
+
+
 def new_task_info(pod: core.Pod) -> TaskInfo:
     """Build a TaskInfo from a Pod (job_info.go:68-93).
 
@@ -143,9 +158,7 @@ def new_task_info(pod: core.Pod) -> TaskInfo:
     MilliValue — summing raw floats first would accumulate binary-float
     error (0.1+0.1+0.1 → 301 mCPU after ceil).
     """
-    resreq = Resource()
-    for c in pod.spec.containers:
-        resreq.add(Resource.from_resource_list(c.resources.get("requests") or {}))
+    resreq = pod_request_resource(pod)
     init_resreq = resreq.clone()
     for c in pod.spec.init_containers:
         init_resreq.set_max(Resource.from_resource_list(c.resources.get("requests") or {}))

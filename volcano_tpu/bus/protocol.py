@@ -48,15 +48,19 @@ MAGIC = b"VBUS"
 #: established through it may receive coalesced ``T_WATCH_BATCH``
 #: frames (N watch events in one frame, batched on the server's writer
 #: thread) instead of one ``T_WATCH_EVENT`` frame per object — the
-#: README known-gap on watch fan-out under commit_batch bursts.  The
-#: frame LAYOUT is unchanged throughout, so frames are STAMPED with
-#: MIN_VERSION — a v1 peer accepts every frame at the framing layer,
-#: and a newer client talking to an older server detects the unknown
-#: op from the typed error and falls back (per-object binds for
-#: ``commit_batch``; a plain ``watch`` for ``watch_batch`` — bus/
+#: README known-gap on watch fan-out under commit_batch bursts.  v4
+#: adds the ``cas_bind`` op: one optimistic-concurrency binding write
+#: (bind iff the pod is still unbound and its resourceVersion matches)
+#: — the federation spillover primitive, one round trip instead of a
+#: get + CAS update.  The frame LAYOUT is unchanged throughout, so
+#: frames are STAMPED with MIN_VERSION — a v1 peer accepts every frame
+#: at the framing layer, and a newer client talking to an older server
+#: detects the unknown op from the typed error and falls back
+#: (per-object binds for ``commit_batch``; a plain ``watch`` for
+#: ``watch_batch``; get + CAS ``update`` for ``cas_bind`` — bus/
 #: remote.py).  VERSION is the protocol revision this build speaks;
 #: receivers accept [MIN_VERSION, VERSION].
-VERSION = 3
+VERSION = 4
 #: oldest frame version this build still decodes — and the version
 #: outgoing frames carry, since the layout has not changed since v1
 MIN_VERSION = 1
@@ -115,6 +119,7 @@ OP_VERSIONS: Dict[str, int] = {
     "register_admission": 1,
     "commit_batch": 2,
     "watch_batch": 3,
+    "cas_bind": 4,
 }
 
 #: wire error name → exception class; unknown names fall back to ApiError
@@ -179,12 +184,25 @@ def parse_bus_url(url: str) -> Tuple[str, int]:
     return host or "127.0.0.1", int(port)
 
 
-def send_frame(sock: socket.socket, mtype: int, corr_id: int, payload: dict) -> None:
-    body = json.dumps(payload, separators=(",", ":")).encode()
+def encode_payload(payload: dict) -> bytes:
+    """Serialize one frame body.  Split out of :func:`send_frame` so the
+    bus server can serialize a watch event ONCE and fan the cached bytes
+    out to every subscriber (the correlation id lives in the frame
+    header, so the body bytes are subscriber-independent)."""
+    return json.dumps(payload, separators=(",", ":")).encode()
+
+
+def send_frame_raw(sock: socket.socket, mtype: int, corr_id: int,
+                   body: bytes) -> None:
+    """Send a frame whose body is already serialized."""
     # stamped MIN_VERSION: the layout is v1's, so version-skewed peers
     # never reject at the framing layer — capability skew surfaces as an
     # op-level typed error instead (the commit_batch fallback path)
     sock.sendall(_HEADER.pack(MAGIC, MIN_VERSION, mtype, corr_id, len(body)) + body)
+
+
+def send_frame(sock: socket.socket, mtype: int, corr_id: int, payload: dict) -> None:
+    send_frame_raw(sock, mtype, corr_id, encode_payload(payload))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:

@@ -133,6 +133,9 @@ class RemoteAPIServer:
         #: then (re-)establish via plain ``watch`` and receive one
         #: T_WATCH_EVENT frame per object, exactly the old behavior
         self._no_watch_batch = False
+        #: set once a server rejects the v4 ``cas_bind`` op — spillover
+        #: binds then degrade to the get + CAS-update equivalent
+        self._no_cas_bind = False
 
         self._ctl: "queue.Queue[tuple]" = queue.Queue()
         self._dispatch_q: "queue.Queue[Optional[tuple]]" = queue.Queue()
@@ -471,6 +474,62 @@ class RemoteAPIServer:
             self, binds=binds, evicts=evicts, events=events,
             conditions=conditions, pod_groups=pod_groups,
         )
+
+    def cas_bind(self, namespace: str, name: str, hostname: str,
+                 expected_rv=None):
+        """Optimistic binding write (protocol v4): one round trip that
+        binds the pod iff it is still unbound and its resourceVersion
+        matches — the federation spillover primitive.  A pre-v4 server
+        answers ``unknown bus op``; the client then degrades PERMANENTLY
+        (per connection lifetime) to the get + CAS ``update``
+        equivalent.  The at-most-once-bind invariant survives the skew
+        unchanged (the conflict is still detected at the store via the
+        expected resourceVersion); the one semantic difference is that
+        ``update`` runs the server's UPDATE admission chain, which the
+        native op skips like any binding subresource — against an old
+        server, a Pod-UPDATE webhook can therefore observe (and reject)
+        spillover binds.  A rejected bind counts as a spillover error
+        and is retried next cycle, never silently dropped."""
+        if not self._no_cas_bind:
+            try:
+                resp = self._call({
+                    "op": "cas_bind", "namespace": namespace,
+                    "name": name, "hostname": hostname,
+                    "expected_rv": expected_rv,
+                })
+                return protocol.decode_obj(resp["object"])
+            except BusError:
+                raise  # transport failure — NOT a capability signal
+            except ApiError as e:
+                if "unknown bus op" not in str(e):
+                    raise
+                log.warning(
+                    "bus %s does not speak cas_bind (old peer); "
+                    "falling back to get + CAS update", self.address,
+                )
+                self._no_cas_bind = True
+        from volcano_tpu.client.apiserver import ConflictError
+
+        pod = self.get("Pod", namespace, name)
+        if pod is None:
+            from volcano_tpu.client.apiserver import NotFoundError
+
+            raise NotFoundError(f"Pod {namespace}/{name} not found")
+        if pod.spec.node_name:
+            raise ConflictError(
+                f"pod {namespace}/{name} already bound to "
+                f"{pod.spec.node_name}"
+            )
+        if (
+            expected_rv is not None
+            and pod.metadata.resource_version != expected_rv
+        ):
+            raise ConflictError(
+                f"Pod {namespace}/{name} resourceVersion "
+                f"{pod.metadata.resource_version} != expected {expected_rv}"
+            )
+        pod.spec.node_name = hostname
+        return self.update(pod, expected_rv=pod.metadata.resource_version)
 
     def record_event(
         self,

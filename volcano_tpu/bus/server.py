@@ -56,6 +56,39 @@ _OUTBOUND_DEPTH = 16384
 _WATCH_BATCH_MAX = 512
 
 
+class _CachedPayload:
+    """A frame body serialized at most once per EVENT, not once per
+    subscriber — the serde hot path under multi-scheduler watch fan-out
+    (with N federated schedulers every store mutation fans out N ways,
+    and re-running ``json.dumps`` per subscriber made encode cost scale
+    O(subscribers)).  The correlation id lives in the frame header, so
+    the cached body bytes are shared verbatim; the watch_batch coalescer
+    splices the per-watch id into the cached bytes instead of re-
+    encoding (see ``_Conn.write_loop``).  Lazily computed on the first
+    writer that ships it; the unsynchronized benign race can at worst
+    serialize twice."""
+
+    __slots__ = ("obj", "_raw")
+
+    def __init__(self, obj: dict):
+        self.obj = obj
+        self._raw: Optional[bytes] = None
+
+    def raw(self) -> bytes:
+        body = self._raw
+        if body is None:
+            body = protocol.encode_payload(self.obj)
+            self._raw = body
+        return body
+
+
+def _splice_watch_id(body: bytes, watch_id: int) -> bytes:
+    """``{"seq":...}`` → ``{"watch_id":N,"seq":...}`` by byte surgery —
+    the batch entry a v3 client decodes as ``dict(entry, watch_id=N)``,
+    without re-serializing the (shared, cached) entry body."""
+    return b'{"watch_id":' + str(watch_id).encode() + b"," + body[1:]
+
+
 class _Conn:
     """One accepted connection: a reader (request handler) thread plus a
     writer thread draining the outbound queue, so watch pushes and
@@ -64,7 +97,8 @@ class _Conn:
     def __init__(self, sock: socket.socket, peer):
         self.sock = sock
         self.peer = peer
-        self.outbound: "queue.Queue[Optional[Tuple[int, int, dict]]]" = queue.Queue(
+        #: (mtype, corr_id, dict-or-_CachedPayload) frames, None = stop
+        self.outbound: "queue.Queue[Optional[Tuple[int, int, object]]]" = queue.Queue(
             maxsize=_OUTBOUND_DEPTH
         )
         self.closed = False
@@ -116,9 +150,11 @@ class _Conn:
             waiter["event"].set()
         self.reviews.clear()
 
-    def _send(self, mtype: int, corr_id: int, payload: dict) -> bool:
+    def _send(self, mtype: int, corr_id: int, payload) -> bool:
         """Send one wire frame (with the bus.delay injection point);
-        False kills the connection."""
+        False kills the connection.  ``payload`` is a dict or a
+        :class:`_CachedPayload` whose bytes are shared across
+        subscribers."""
         from volcano_tpu import faults
 
         fp = faults.get_plane()
@@ -128,7 +164,26 @@ class _Conn:
             # store (the decoupling this queue exists for)
             time.sleep(fp.param_ms("bus.delay") / 1e3)
         try:
-            protocol.send_frame(self.sock, mtype, corr_id, payload)
+            if isinstance(payload, _CachedPayload):
+                protocol.send_frame_raw(self.sock, mtype, corr_id,
+                                        payload.raw())
+            else:
+                protocol.send_frame(self.sock, mtype, corr_id, payload)
+            return True
+        except (OSError, ValueError):
+            self.kill()
+            return False
+
+    def _send_raw(self, mtype: int, corr_id: int, body: bytes) -> bool:
+        """Pre-assembled body variant of :meth:`_send` (the watch-batch
+        splice path); same delay injection and failure semantics."""
+        from volcano_tpu import faults
+
+        fp = faults.get_plane()
+        if fp.enabled and fp.should("bus.delay"):
+            time.sleep(fp.param_ms("bus.delay") / 1e3)
+        try:
+            protocol.send_frame_raw(self.sock, mtype, corr_id, body)
             return True
         except (OSError, ValueError):
             self.kill()
@@ -149,12 +204,15 @@ class _Conn:
             # burst before this thread wakes — drain the consecutive
             # watch events greedily and ship ONE T_WATCH_BATCH frame.
             # Each entry carries its watch id (the correlation-id slot
-            # holds only one); entry dicts are shared with the server
-            # backlog and other connections, so copy-extend, never
-            # mutate.  A non-watch frame (response, bookmark, admission
-            # review) is an ordering barrier: it flushes the batch and
-            # is sent right after, in queue order.
-            batch = [dict(payload, watch_id=corr_id)]
+            # holds only one); entry payloads are shared with the server
+            # backlog and other connections, so the id is SPLICED into
+            # each entry's cached bytes — the entry body itself is
+            # serialized once per event cluster-wide, not once per
+            # subscriber (the serde hot path).  A non-watch frame
+            # (response, bookmark, admission review) is an ordering
+            # barrier: it flushes the batch and is sent right after, in
+            # queue order.
+            batch = [(corr_id, payload)]
             tail = None
             drained_stop = False
             while len(batch) < _WATCH_BATCH_MAX:
@@ -168,12 +226,22 @@ class _Conn:
                 if nxt[0] != protocol.T_WATCH_EVENT:
                     tail = nxt
                     break
-                batch.append(dict(nxt[2], watch_id=nxt[1]))
+                batch.append((nxt[1], nxt[2]))
             if len(batch) == 1:
                 ok = self._send(mtype, corr_id, payload)
             else:
                 metrics.observe_watch_batch(len(batch))
-                ok = self._send(protocol.T_WATCH_BATCH, 0, {"events": batch})
+                parts = []
+                for wid, p in batch:
+                    body = (
+                        p.raw() if isinstance(p, _CachedPayload)
+                        else protocol.encode_payload(p)
+                    )
+                    parts.append(_splice_watch_id(body, wid))
+                ok = self._send_raw(
+                    protocol.T_WATCH_BATCH, 0,
+                    b'{"events":[' + b",".join(parts) + b"]}",
+                )
             if not ok:
                 return
             if tail is not None and not self._send(*tail):
@@ -206,7 +274,9 @@ class BusServer:
         #: sequence numbers, so it is answered with relist-required.
         self.epoch = uuid.uuid4().hex
         self._seq = 0  # guarded-by: self.api.locked()
-        self._backlog: List[dict] = []  # guarded-by: self.api.locked()
+        #: retained watch entries (cached-payload wrappers, shared with
+        #: every subscriber queue)
+        self._backlog: List[_CachedPayload] = []  # guarded-by: self.api.locked()
         #: kind → [(conn, watch_id)] live subscriptions
         self._subs: Dict[str, List[Tuple[_Conn, int]]] = {}  # guarded-by: self.api.locked()
         #: (kind, operation) → [conn] remote admission registrations;
@@ -298,14 +368,14 @@ class BusServer:
             # (store watchers fire under the store lock — the
             # _notify discipline documented on APIServer.locked)
             self._seq += 1
-            entry = {
+            entry = _CachedPayload({
                 "seq": self._seq,
                 "kind": kind,
                 "event": event,
                 "old": protocol.encode_obj(old),
                 "new": protocol.encode_obj(new),
                 "ts": time.time(),
-            }
+            })
             self._backlog.append(entry)
             if len(self._backlog) > self.backlog_size:
                 del self._backlog[: len(self._backlog) - self.backlog_size]
@@ -321,6 +391,9 @@ class BusServer:
                     # this entry from the backlog.
                     conn.kill()
                     continue
+                # the SAME cached payload goes to every subscriber —
+                # its body serializes once, on the first writer thread
+                # that ships it (the multi-scheduler fan-out hot path)
                 conn.push(protocol.T_WATCH_EVENT, watch_id, entry)
 
         return on_event
@@ -328,7 +401,9 @@ class BusServer:
     def _bookmark_loop(self) -> None:
         while not self._stop.wait(self.bookmark_interval):
             with self.api.locked():
-                payload = {"seq": self._seq, "ts": time.time()}
+                payload = _CachedPayload(
+                    {"seq": self._seq, "ts": time.time()}
+                )
                 for subs in self._subs.values():
                     for conn, watch_id in subs:
                         conn.push(protocol.T_BOOKMARK, watch_id, payload)
@@ -503,6 +578,15 @@ class BusServer:
                 ],
             )
             return {"results": results}
+        if op == "cas_bind":
+            # v4: one optimistic binding write — bind iff still unbound
+            # and the resourceVersion matches (the federation spillover
+            # primitive; conflicts detected at the store, Omega-style)
+            obj = api.cas_bind(
+                payload["namespace"], payload["name"], payload["hostname"],
+                expected_rv=payload.get("expected_rv"),
+            )
+            return {"object": protocol.encode_obj(obj)}
         if op == "watch":
             self._handle_watch(conn, req_id, payload)
             return None  # responses pushed inline for ordering
@@ -565,7 +649,10 @@ class BusServer:
                     "resumed": True, "epoch": self.epoch, "seq": self._seq,
                 })
                 for entry in self._backlog:
-                    if entry["seq"] > resume_seq and entry["kind"] == kind:
+                    if (
+                        entry.obj["seq"] > resume_seq
+                        and entry.obj["kind"] == kind
+                    ):
                         conn.push(protocol.T_WATCH_EVENT, watch_id, entry)
             else:
                 initial = [protocol.encode_obj(o) for o in self.api.list(kind)]

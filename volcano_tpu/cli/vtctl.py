@@ -393,6 +393,60 @@ def _describe_podgroup(vc: VolcanoClient, args, out) -> int:
     return 0
 
 
+# ---- shards (the federation observability surface) ----
+
+def _shards(vc: VolcanoClient, args, out) -> int:
+    """Render the live shard map: per-shard lease holders, the member
+    heartbeats, and each member's published stats (nodes owned,
+    spillover counters, rebalances).  Reads ONLY the shard-map
+    ConfigMap through the API surface, so the output is byte-identical
+    over the in-process backend and ``--bus`` for the same store
+    state."""
+    from volcano_tpu.federation import read_shard_map
+
+    rec = read_shard_map(vc.api)
+    if rec is None:
+        print("no shard map — the federation has not run "
+              "(start schedulers with --shards N)", file=out)
+        return 1
+    n = int(rec.get("nShards", 0))
+    print(f"Shards:             {n}", file=out)
+    print(f"  {'SHARD':<7}{'HOLDER':<22}{'LEASE':<8}{'RENEWED':<20}", file=out)
+    for i in range(n):
+        entry = rec.get("shards", {}).get(str(i), {})
+        holder = entry.get("holder") or "<unheld>"
+        lease = entry.get("leaseDurationSeconds", 0)
+        renewed = entry.get("renewTime", 0)
+        print(f"  {i:<7}{holder:<22}{lease:<8g}{renewed:<20}", file=out)
+    members = rec.get("members", {})
+    print("Members:", file=out)
+    if not members:
+        print("  <none>", file=out)
+    for ident in sorted(members):
+        m = members[ident]
+        print(
+            f"  {ident:<22}heartbeat {m.get('heartbeat', 0)}  "
+            f"lease {m.get('leaseDurationSeconds', 0):g}s",
+            file=out,
+        )
+    stats = rec.get("stats", {})
+    if stats:
+        print("Stats:", file=out)
+        for ident in sorted(stats):
+            s = stats[ident]
+            spill = s.get("spillover", {})
+            spill_txt = " ".join(
+                f"{k}={spill[k]}" for k in sorted(spill)
+            ) or "<none>"
+            print(
+                f"  {ident:<22}nodes={s.get('nodesOwned', 0)} "
+                f"rebalances={s.get('rebalances', 0)} "
+                f"spillover: {spill_txt}",
+                file=out,
+            )
+    return 0
+
+
 # ---- trace subcommands (volcano_tpu/trace) ----
 
 def _faults_validate(vc: VolcanoClient, args, out) -> int:
@@ -588,6 +642,13 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("--name", "-N", required=True)
         p.add_argument("--namespace", "-n", default="default")
 
+    shards = sub.add_parser(
+        "shards",
+        description="live shard map: lease holders, member heartbeats, "
+        "spillover counters (sharded scheduler federation)",
+    )
+    shards.set_defaults(cmd=None)
+
     trace_p = sub.add_parser(
         "trace", description="cycle journal: record, replay, diff, export"
     ).add_subparsers(dest="cmd", required=True)
@@ -663,6 +724,7 @@ _HANDLERS = {
     ("queue", "delete"): _queue_delete,
     ("describe", "job"): _describe_job,
     ("describe", "podgroup"): _describe_podgroup,
+    ("shards", None): _shards,
     ("faults", "validate"): _faults_validate,
     ("trace", "record"): _trace_record,
     ("trace", "replay"): _trace_replay,

@@ -238,6 +238,38 @@ class APIServer:
             obj = self._store.get(kind, {}).get(f"{namespace}/{name}")
             return obj.clone() if obj is not None else None
 
+    def cas_bind(self, namespace: str, name: str, hostname: str,
+                 expected_rv: Optional[int] = None):
+        """Optimistic-concurrency binding write: set the pod's nodeName
+        iff it is still unbound (and, when ``expected_rv`` is given, its
+        resourceVersion is unchanged) — one atomic check-and-bind under
+        the store lock.  The federation spillover primitive: concurrent
+        schedulers racing for one pod resolve HERE, at the store, with a
+        ConflictError for the loser (Omega-style shared-state
+        concurrency; PAPERS.md).  Like the binding subresource it skips
+        admission."""
+        with self._lock:
+            pod = self._store.get("Pod", {}).get(f"{namespace}/{name}")
+            if pod is None:
+                raise NotFoundError(f"Pod {namespace}/{name} not found")
+            if pod.spec.node_name:
+                raise ConflictError(
+                    f"pod {namespace}/{name} already bound to "
+                    f"{pod.spec.node_name}"
+                )
+            if (
+                expected_rv is not None
+                and pod.metadata.resource_version != expected_rv
+            ):
+                raise ConflictError(
+                    f"Pod {namespace}/{name} resourceVersion "
+                    f"{pod.metadata.resource_version} != expected "
+                    f"{expected_rv}"
+                )
+            bound = pod.clone()
+            bound.spec.node_name = hostname
+            return self.update_status(bound)
+
     # ---- coalesced commit transaction (the multi-bind frame) ----
 
     def commit_batch(

@@ -43,6 +43,9 @@ class SchedulerDaemon(BaseDaemon):
         pipelined_commit: bool = False,
         micro_cycles: bool = False,
         micro_debounce_ms: float = 5.0,
+        shards: int = 0,
+        shard_identity: str = "",
+        shard_lease_duration: float = 2.0,
         **daemon_kw,
     ):
         # /explain reads self.cache lazily (set right below) — the
@@ -56,6 +59,37 @@ class SchedulerDaemon(BaseDaemon):
             explain_source=lambda ns, job: _explain_source(self, ns, job),
             **daemon_kw,
         )
+        self.federation = None
+        if shards >= 1:
+            # sharded federation: the shard-assignment leases replace
+            # the leader-elected standby pattern (each member is active
+            # over its own slice), so --leader-elect is ignored here
+            from volcano_tpu.federation import FederatedScheduler
+
+            self.federation = FederatedScheduler(
+                api,
+                identity=shard_identity or self.identity,
+                n_shards=shards,
+                scheduler_conf_path=scheduler_conf,
+                period=schedule_period,
+                micro_cycles=micro_cycles,
+                micro_debounce_ms=micro_debounce_ms,
+                lease_duration=shard_lease_duration,
+                pipelined_commit=pipelined_commit,
+                snapshot_reuse=snapshot_reuse,
+                scheduler_name=scheduler_name,
+                kill_mode="exit",  # shard.kill hard-exits the process
+            )
+            self.elector = None
+            self.cache = self.federation.cache
+            self.scheduler = self.federation.scheduler
+            if cycle_deadline_ms is not None:
+                from volcano_tpu.faults import watchdog
+
+                watchdog.configure_deadline(cycle_deadline_ms)
+            if gc_quiesce_period:
+                self.scheduler.gc_quiesce_period = gc_quiesce_period
+            return
         self.cache = SchedulerCache(
             client=SchedulerClient(api),
             scheduler_name=scheduler_name,
@@ -71,7 +105,10 @@ class SchedulerDaemon(BaseDaemon):
         )
 
     def _on_start(self) -> None:
-        self.cache.run()
+        if self.federation is not None:
+            self.federation.start()  # cache.run() + the lease loop
+        else:
+            self.cache.run()
 
     def _work(self) -> None:
         if self.scheduler.micro_cycles:
@@ -83,6 +120,12 @@ class SchedulerDaemon(BaseDaemon):
         # wake the scheduler's condition wait first, or the loop join
         # would wait out the in-flight window
         self.scheduler.stop()
+        if self.federation is not None:
+            if crash:
+                self.federation.leases.stop(release=False)
+            else:
+                self.federation.leases.stop(release=True)
+            self.federation.cache.stop_commit_plane()
         super().stop(crash=crash)
 
 
@@ -162,6 +205,24 @@ def main(argv=None) -> int:
         "lands in the same micro-cycle",
     )
     parser.add_argument(
+        "--shards", type=int, default=0,
+        help="sharded scheduler federation: run as one of N scheduler "
+        "processes each owning a disjoint node shard via bus-backed "
+        "shard-assignment leases, with cross-shard spillover binds for "
+        "jobs that fail to place on their home shard (0 = off; 1 = "
+        "single-shard federation, bit-identical to the plain scheduler)",
+    )
+    parser.add_argument(
+        "--shard-identity", default="",
+        help="stable identity in the shard map (defaults to the daemon "
+        "identity); distinct per federation member",
+    )
+    parser.add_argument(
+        "--shard-lease-duration", type=float, default=2.0,
+        help="shard lease TTL, seconds: a crashed member's slices are "
+        "absorbed by survivors within one TTL",
+    )
+    parser.add_argument(
         "--warmup", action="store_true",
         help="compile the headline-bucket session kernels before the "
         "first cycle (first compile is ~20-40s on TPU; same flag as "
@@ -235,6 +296,9 @@ def main(argv=None) -> int:
             pipelined_commit=args.pipelined_commit,
             micro_cycles=args.micro_cycles,
             micro_debounce_ms=args.micro_debounce_ms,
+            shards=args.shards,
+            shard_identity=args.shard_identity,
+            shard_lease_duration=args.shard_lease_duration,
             listen_host=args.listen_host,
             listen_port=args.listen_port,
             leader_elect=args.leader_elect,

@@ -71,6 +71,16 @@ DEFAULT_VALUES: Dict[str, Any] = {
         # loop; submit→bind latency under churn drops from ~a period to
         # ~a cycle.  Off only for debugging cadence-sensitive policies.
         "micro_cycles": True,
+        # sharded scheduler federation: N > 1 renders N shard-pinned
+        # scheduler Deployments (each --shards N with a stable
+        # identity), REPLACING the leader-elected standby pair — every
+        # member is active over its own node slice, ownership moves via
+        # bus-backed shard leases, and a dead member's slices are
+        # absorbed by survivors within one lease TTL.  Each member pod
+        # still demands a full TPU slice.  0/1 keeps the single
+        # scheduler (with `replicas: 2` leader-elected standby HA).
+        "shards": 0,
+        "shard_lease_duration": 2.0,
     },
     "controllers": {
         "port": 8081,
@@ -300,69 +310,102 @@ def render(values: Dict[str, Any]) -> List[Tuple[str, Dict[str, Any]]]:
         },
     }))
 
-    # ---- scheduler: leader-elected replicas + compute-plane sidecar ----
+    # ---- scheduler: leader-elected replicas + compute-plane sidecar,
+    # or N shard-pinned federation members when scheduler.shards > 1 ----
     sched_replicas = int(values["scheduler"].get("replicas", 1))
-    sched_cmd = [
-        "vtpu-scheduler",
-        "--bus", bus_url,
-        "--listen-host", "0.0.0.0",
-        "--listen-port", str(sched_port),
-        "--scheduler-conf", "/etc/volcano-tpu/volcano-scheduler.conf",
-    ]
-    if values["scheduler"].get("micro_cycles"):
-        sched_cmd.append("--micro-cycles")
-    if sched_replicas > 1:
-        sched_cmd.append("--leader-elect")
-    scheduler: Dict[str, Any] = {
-        "name": "scheduler",
-        "image": image,
-        "command": sched_cmd,
-        "volumeMounts": [
-            {"name": "scheduler-config", "mountPath": "/etc/volcano-tpu"},
-        ],
-        "livenessProbe": _probe(sched_port),
-        "ports": [{"containerPort": sched_port, "name": "metrics"}],
-    }
-    sched_containers = [scheduler]
-    sched_volumes: List[Dict[str, Any]] = [
-        {"name": "scheduler-config",
-         "configMap": {"name": f"{name}-scheduler-configmap"}},
-    ]
-    if cp["enabled"]:
-        socket = f"{cp['socket_dir']}/compute-plane.sock"
-        scheduler["env"] = [{"name": "VTPU_COMPUTE_PLANE", "value": socket}]
-        scheduler["volumeMounts"].append(
-            {"name": "compute-plane-socket", "mountPath": cp["socket_dir"]})
-        sidecar_cmd = ["vtpu-compute-plane", "--socket", socket]
-        if cp["warmup"]:
-            sidecar_cmd.append("--warmup")
-        sched_containers.append({
-            "name": "compute-plane",
-            "image": image,
-            "command": sidecar_cmd,
-            "volumeMounts": [
-                {"name": "compute-plane-socket", "mountPath": cp["socket_dir"]},
-            ],
-            "resources": {
-                "limits": {cp["tpu_resource"]: str(cp["tpu_chips"])},
-            },
-        })
-        sched_volumes.append({"name": "compute-plane-socket", "emptyDir": {}})
-    else:
-        # in-process kernels: the scheduler itself owns the device, so
-        # the TPU limit moves onto it
-        scheduler["resources"] = {
-            "limits": {cp["tpu_resource"]: str(cp["tpu_chips"])},
-        }
+    shards = int(values["scheduler"].get("shards", 0) or 0)
 
-    manifests.append(("30-scheduler-deployment.yaml", _deployment(
-        f"{name}-scheduler", ns, {"app": f"{name}-scheduler"},
-        containers=sched_containers, volumes=sched_volumes,
-        replicas=sched_replicas,
-        annotations=scrape(sched_port),
-        image_pull_secret=pull_secret,
-        strategy="Recreate",
-    )))
+    def scheduler_manifest(fname: str, deploy_name: str,
+                           extra_args: List[str],
+                           leader_elect: bool) -> Tuple[str, Dict[str, Any]]:
+        sched_cmd = [
+            "vtpu-scheduler",
+            "--bus", bus_url,
+            "--listen-host", "0.0.0.0",
+            "--listen-port", str(sched_port),
+            "--scheduler-conf", "/etc/volcano-tpu/volcano-scheduler.conf",
+        ]
+        if values["scheduler"].get("micro_cycles"):
+            sched_cmd.append("--micro-cycles")
+        if leader_elect:
+            sched_cmd.append("--leader-elect")
+        sched_cmd.extend(extra_args)
+        scheduler: Dict[str, Any] = {
+            "name": "scheduler",
+            "image": image,
+            "command": sched_cmd,
+            "volumeMounts": [
+                {"name": "scheduler-config",
+                 "mountPath": "/etc/volcano-tpu"},
+            ],
+            "livenessProbe": _probe(sched_port),
+            "ports": [{"containerPort": sched_port, "name": "metrics"}],
+        }
+        sched_containers = [scheduler]
+        sched_volumes: List[Dict[str, Any]] = [
+            {"name": "scheduler-config",
+             "configMap": {"name": f"{name}-scheduler-configmap"}},
+        ]
+        if cp["enabled"]:
+            socket = f"{cp['socket_dir']}/compute-plane.sock"
+            scheduler["env"] = [
+                {"name": "VTPU_COMPUTE_PLANE", "value": socket}]
+            scheduler["volumeMounts"].append(
+                {"name": "compute-plane-socket",
+                 "mountPath": cp["socket_dir"]})
+            sidecar_cmd = ["vtpu-compute-plane", "--socket", socket]
+            if cp["warmup"]:
+                sidecar_cmd.append("--warmup")
+            sched_containers.append({
+                "name": "compute-plane",
+                "image": image,
+                "command": sidecar_cmd,
+                "volumeMounts": [
+                    {"name": "compute-plane-socket",
+                     "mountPath": cp["socket_dir"]},
+                ],
+                "resources": {
+                    "limits": {cp["tpu_resource"]: str(cp["tpu_chips"])},
+                },
+            })
+            sched_volumes.append(
+                {"name": "compute-plane-socket", "emptyDir": {}})
+        else:
+            # in-process kernels: the scheduler itself owns the device,
+            # so the TPU limit moves onto it
+            scheduler["resources"] = {
+                "limits": {cp["tpu_resource"]: str(cp["tpu_chips"])},
+            }
+        return (fname, _deployment(
+            deploy_name, ns, {"app": deploy_name},
+            containers=sched_containers, volumes=sched_volumes,
+            # federation members are shard-pinned singletons: their HA
+            # is the lease plane itself (survivors absorb an expired
+            # member's slices), not a standby replica
+            replicas=1 if shards > 1 else sched_replicas,
+            annotations=scrape(sched_port),
+            image_pull_secret=pull_secret,
+            strategy="Recreate",
+        ))
+
+    if shards > 1:
+        lease = values["scheduler"].get("shard_lease_duration", 2.0)
+        for i in range(shards):
+            manifests.append(scheduler_manifest(
+                f"30-scheduler-{i}-deployment.yaml",
+                f"{name}-scheduler-{i}",
+                extra_args=[
+                    "--shards", str(shards),
+                    "--shard-identity", f"{name}-scheduler-{i}",
+                    "--shard-lease-duration", str(lease),
+                ],
+                leader_elect=False,
+            ))
+    else:
+        manifests.append(scheduler_manifest(
+            "30-scheduler-deployment.yaml", f"{name}-scheduler",
+            extra_args=[], leader_elect=sched_replicas > 1,
+        ))
 
     # ---- controllers ----
     ctrl_replicas = int(values["controllers"].get("replicas", 1))

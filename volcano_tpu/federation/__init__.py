@@ -1,0 +1,46 @@
+"""Sharded scheduler federation — scale *across* scheduler processes.
+
+The reference Volcano runs exactly one vc-scheduler against the API
+server (PAPER.md layer map); everything before this package scaled the
+one process (device kernels, warm packing, the pipelined commit plane,
+event-driven micro-cycles).  Federation partitions the cluster itself:
+N scheduler processes each own a disjoint **node shard** via bus-backed
+shard-assignment leases (the ``serving/leader.py`` CAS-lease machinery
+generalized to a shard map object), run the full existing pipeline over
+their slice, and handle cross-shard pressure with Omega-style
+optimistic CAS binds — conflicts are detected at the store, never
+prevented by locks (the shared-state scheduling lineage in PAPERS.md).
+
+Pieces:
+
+* :mod:`sharding` — the deterministic hash assignment (node → shard,
+  job → home shard) and the ``ShardState`` ownership set.
+* :mod:`leases` — ``ShardLeaseManager``: claim / renew / absorb-on-
+  expiry / release-on-join over one CAS-updated ConfigMap.
+* :mod:`filter` — ``ShardInformerFilter``: shard-filters informer
+  deliveries so cache and pack stay O(nodes/N), with relist-on-acquire
+  when ownership moves; also feeds the foreign-node spillover ledger.
+* :mod:`spillover` — ``SpilloverController``: home-shard-stuck tasks
+  CAS-bind onto foreign-shard nodes with bounded retry on conflict.
+* :mod:`runtime` — ``FederatedScheduler``: one federation member
+  (cache + filter + leases + spillover + scheduler), the unit
+  ``vtpu-scheduler --shards N`` runs and the tests/loadgen harnesses
+  instantiate in-process.
+* :mod:`verify` — the multi-shard policy-equivalence checker (each pod
+  bound at most once, binds satisfy predicates, gang minMember honored
+  within home shards).
+"""
+
+from volcano_tpu.federation.sharding import (  # noqa: F401
+    home_shard,
+    shard_of_node,
+    ShardState,
+)
+from volcano_tpu.federation.leases import (  # noqa: F401
+    read_shard_map,
+    SHARD_MAP_KEY,
+    SHARD_MAP_NAME,
+    ShardLeaseManager,
+)
+from volcano_tpu.federation.runtime import FederatedScheduler  # noqa: F401
+from volcano_tpu.federation.verify import verify_federation  # noqa: F401

@@ -1,0 +1,174 @@
+"""``FederatedScheduler`` — one federation member, fully assembled.
+
+Composition (all existing machinery, re-pointed at a slice):
+
+    informer feed ─▶ ShardInformerFilter ─▶ SchedulerCache ─▶ Scheduler
+                          ▲      │ledger                        │post_cycle
+    ShardLeaseManager ────┘      └──────────▶ SpilloverController
+
+The scheduler loop itself is untouched: micro-cycles, the pipelined
+commit plane, snapshot reuse, pack caching all run exactly as in the
+single-process build, just over the owned subset.  ``--shards 1`` is
+therefore bit-identical to the non-federated scheduler by construction
+(the filter passes everything, spillover is a no-op) — and the tests
+pin it through ``trace.replay.verify``.
+
+The ``shard.kill`` fault point makes shard-loss chaos deterministic:
+when the seeded plane fires it at the post-cycle seam, an in-process
+member crash-stops (leases left to expire — the SIGKILL-observable
+behavior) and a daemon-hosted member hard-exits the OS process.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.client import SchedulerClient
+from volcano_tpu.federation.filter import ShardInformerFilter
+from volcano_tpu.federation.leases import ShardLeaseManager
+from volcano_tpu.federation.sharding import ShardState
+from volcano_tpu.federation.spillover import SpilloverController
+from volcano_tpu.scheduler.scheduler import Scheduler
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class FederatedScheduler:
+    """Cache + filter + leases + spillover + scheduler for one member.
+
+    ``api`` is any APIServer surface (in-process or RemoteAPIServer);
+    ``kill_mode`` governs the ``shard.kill`` fault point: ``"crash"``
+    (in-process harnesses: stop without releasing leases) or
+    ``"exit"`` (daemon processes: ``os._exit`` — the real SIGKILL
+    twin).
+    """
+
+    def __init__(
+        self,
+        api,
+        identity: str,
+        n_shards: int,
+        scheduler_conf_path: str = "",
+        period: float = 1.0,
+        micro_cycles: bool = False,
+        micro_debounce_ms: float = 5.0,
+        lease_duration: float = 2.0,
+        lease_retry_period: float = 0.2,
+        pipelined_commit: bool = False,
+        snapshot_reuse: bool = False,
+        scheduler_name: str = "volcano-tpu",
+        spill_after: int = 2,
+        kill_mode: str = "crash",
+    ):
+        self.api = api
+        self.identity = identity
+        self.kill_mode = kill_mode
+        self.client = SchedulerClient(api)
+        self.cache = SchedulerCache(
+            client=self.client,
+            scheduler_name=scheduler_name,
+            pipelined_commit=pipelined_commit,
+            snapshot_reuse=snapshot_reuse,
+        )
+        self.state = ShardState(n_shards)
+        self.filter = ShardInformerFilter(self.cache, self.state, lister=api)
+        self.cache.set_informer_sink(self.filter)
+        self.spillover = SpilloverController(
+            self.cache, self.state, self.filter, api,
+            spill_after=spill_after,
+        )
+        self.leases = ShardLeaseManager(
+            api, identity, n_shards,
+            lease_duration=lease_duration,
+            retry_period=lease_retry_period,
+            on_acquire=self._on_acquire,
+            on_release=self._on_release,
+            stats=self._stats,
+        )
+        self.scheduler = Scheduler(
+            self.cache,
+            scheduler_conf_path=scheduler_conf_path,
+            period=period,
+            micro_cycles=micro_cycles,
+            micro_debounce_ms=micro_debounce_ms,
+        )
+        self.scheduler.post_cycle = self._post_cycle
+        self._owned_event = threading.Event()
+        self._crashed = False
+
+    # ---- lease callbacks (lease-manager thread) ----
+
+    def _on_acquire(self, shard: int) -> None:
+        self.state.acquire(shard)
+        self.filter.on_acquire(shard)
+        self._owned_event.set()
+        # new nodes routed a "topology" wake already; jobs relisted via
+        # add_pod woke "task" — nothing further needed here
+
+    def _on_release(self, shard: int) -> None:
+        self.state.release(shard)
+        self.filter.on_release(shard)
+        if not self.state.owned():
+            self._owned_event.clear()
+
+    def _stats(self) -> dict:
+        # piggybacks on the renew tick: retry any failed relist, then
+        # publish this member's observability blob into the map object
+        self.filter.retry_pending_relists()
+        return {
+            "nodesOwned": self.filter.owned_node_count(),
+            "spillover": self.spillover.counters(),
+            "rebalances": self.leases.rebalances,
+        }
+
+    # ---- scheduler hook ----
+
+    def _post_cycle(self) -> None:
+        from volcano_tpu import faults
+
+        fp = faults.get_plane()
+        if fp.enabled and fp.should("shard.kill"):
+            log.error("shard.kill fired: %s going down hard", self.identity)
+            if self.kill_mode == "exit":
+                import os
+
+                os._exit(137)  # SIGKILL's exit code — no cleanup, no
+                # lease release; survivors absorb after expiry
+            self.crash()
+            return
+        self.spillover.run_once()
+
+    # ---- lifecycle ----
+
+    def start(self) -> "FederatedScheduler":
+        """Informers + lease loop.  The scheduler loop itself is the
+        caller's (daemon ``_work`` / ``run()`` below / a test driving
+        ``run_once`` by hand)."""
+        self.cache.run()
+        self.leases.start()
+        return self
+
+    def wait_owned(self, timeout: float = 10.0) -> bool:
+        """Gate for harnesses: block until this member owns ≥1 shard."""
+        return self._owned_event.wait(timeout)
+
+    def run(self, cycles: Optional[int] = None) -> None:
+        self.scheduler.run(cycles=cycles)
+
+    def stop(self) -> None:
+        """Graceful: release shards so peers take over immediately."""
+        self.scheduler.stop()
+        self.leases.stop(release=True)
+        self.cache.stop_commit_plane()
+
+    def crash(self) -> None:
+        """SIGKILL semantics for in-process members: stop scheduling
+        and renewing but leave every lease to EXPIRE — the takeover
+        path the chaos tests exercise."""
+        self._crashed = True
+        self.scheduler.stop()
+        self.leases.stop(release=False)
+        self.cache.stop_commit_plane()

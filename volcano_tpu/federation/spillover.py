@@ -1,0 +1,178 @@
+"""Cross-shard spillover — Omega-style optimistic CAS binds.
+
+A job that keeps failing to place on its home shard is not stuck: its
+home scheduler may simply own a full slice while a foreign slice sits
+idle.  Locking foreign state is exactly what the shared-state lineage
+(PAPERS.md) rejects; instead the home scheduler *optimistically* binds
+the pod onto a foreign node and lets the store detect conflicts — the
+``cas_bind`` operation succeeds only if the pod is still unbound and
+its resourceVersion is unchanged, so two schedulers racing for one pod
+(or a deleted pod racing its bind) resolve at the store, never by
+coordination.  Conflicts are retried against the next candidate, a
+bounded number of times, and every outcome is counted in
+``volcano_spillover_binds_total{result}`` so spillover pressure — the
+signal that the shard hash is skewed for this workload — is observable
+(also published into the shard-map ConfigMap for ``vtctl shards``).
+
+Eligibility is deliberately conservative:
+
+* a task spills only after staying Pending across
+  ``spill_after`` consecutive post-cycle observations — the home cycle
+  must have had a real chance first (spilling instantly would bypass
+  home scheduling entirely);
+* **gang semantics stay within home shards**: a task of a
+  ``minMember > 1`` group spills only when the gang is already
+  satisfied at home (the spill is surplus), never to assemble a gang
+  across shards — stated honestly in the README known-gaps ledger.
+
+Runs on the scheduler thread via ``Scheduler.post_cycle`` — never
+concurrently with a session, so a freshly-spilled pod can't race its
+own home placement.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from volcano_tpu.client.apiserver import ApiError, ConflictError
+from volcano_tpu.federation.filter import ShardInformerFilter
+from volcano_tpu.federation.sharding import ShardState
+from volcano_tpu.metrics import metrics
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+# Both API surfaces a controller can hold implement ``cas_bind`` —
+# the in-process APIServer natively, RemoteAPIServer as the VBUS v4 op
+# (with its own old-server get+CAS-update fallback).  The check-and-
+# bind logic deliberately lives in those two places ONLY; a surface
+# without the method fails loudly here rather than getting a third,
+# drift-prone copy.
+
+
+class SpilloverController:
+    """Post-cycle spillover pass for one federation member."""
+
+    def __init__(
+        self,
+        cache,
+        state: ShardState,
+        filter_: ShardInformerFilter,
+        api,
+        spill_after: int = 2,
+        max_per_cycle: int = 128,
+        candidate_retries: int = 3,
+    ):
+        self.cache = cache
+        self.state = state
+        self.filter = filter_
+        self.api = api
+        self.spill_after = spill_after
+        self.max_per_cycle = max_per_cycle
+        self.candidate_retries = candidate_retries
+        #: pod key → consecutive post-cycle observations still Pending
+        #: (scheduler-thread state; run_once is never reentered)
+        self._seen: Dict[str, int] = {}
+        self._ctr_lock = threading.Lock()
+        #: result → count, mirrored into the shard-map stats blob
+        self._counters: Dict[str, int] = {}  # guarded-by: self._ctr_lock
+
+    def counters(self) -> Dict[str, int]:
+        with self._ctr_lock:
+            return dict(self._counters)
+
+    def _count(self, result: str) -> None:
+        metrics.register_spillover_bind(result)
+        with self._ctr_lock:
+            self._counters[result] = self._counters.get(result, 0) + 1
+
+    def run_once(self) -> int:
+        """One spillover pass (Scheduler.post_cycle).  Returns how many
+        pods were successfully spilled."""
+        if self.state.n_shards <= 1:
+            return 0
+        view = self.cache.pending_spill_view()
+        live = set()
+        eligible = []
+        for entry in view:
+            if not self.state.owns_job_id(entry["job_id"]):
+                continue  # not ours to spill (mid-rebalance residue)
+            gang_ok = (
+                entry["min_member"] <= 1
+                or entry["ready"] >= entry["min_member"]
+            )
+            for task in entry["tasks"]:
+                key = f"{task.namespace}/{task.name}"
+                live.add(key)
+                seen = self._seen.get(key, 0) + 1
+                self._seen[key] = seen
+                if gang_ok and seen > self.spill_after:
+                    eligible.append(task)
+        # tasks that bound, finished, or left drop their streak
+        for key in list(self._seen):
+            if key not in live:
+                del self._seen[key]
+        spilled = 0
+        for task in eligible[: self.max_per_cycle]:
+            if self._spill_one(task):
+                spilled += 1
+                self._seen.pop(f"{task.namespace}/{task.name}", None)
+        return spilled
+
+    def _spill_one(self, task) -> bool:
+        candidates = self.filter.spill_candidates(
+            task, limit=self.candidate_retries
+        )
+        if not candidates:
+            self._count("no-fit")
+            return False
+        for hostname in candidates:
+            try:
+                pre = self.api.get("Pod", task.namespace, task.name)
+                if pre is None or pre.spec.node_name:
+                    # someone else bound (or deleted) it since the cycle
+                    self._count("lost-race")
+                    return False
+                bound = self.api.cas_bind(
+                    task.namespace, task.name, hostname,
+                    expected_rv=pre.metadata.resource_version,
+                )
+            except ConflictError:
+                self._count("conflict")
+                continue  # optimistic concurrency working as intended
+            except ApiError as e:
+                log.error("spillover bind of %s/%s to %s failed: %s",
+                          task.namespace, task.name, hostname, e)
+                self._count("error")
+                return False
+            self._count("bound")
+            log.info("spillover: bound %s/%s to foreign node %s",
+                     task.namespace, task.name, hostname)
+            # account immediately — the watch echo reconciles later, and
+            # the very next home cycle must not re-place this pod
+            self.filter.note_spill_bind(bound)
+            try:
+                self.cache.update_pod(pre, bound)
+            except Exception as e:  # noqa: BLE001 — accounting races the
+                # echo; the informer delivery converges it
+                log.debug("spillover cache account: %s", e)
+            try:
+                from volcano_tpu.client.clients import record_event_via
+
+                record_event_via(
+                    self.api, task.namespace,
+                    {"kind": "Pod", "namespace": task.namespace,
+                     "name": task.name},
+                    "Normal", "Scheduled",
+                    f"Successfully assigned {task.namespace}/{task.name}"
+                    f" to {hostname} (cross-shard spillover)",
+                )
+            except ApiError:
+                pass  # audit events are best-effort, like _record_event
+            return True
+        # every candidate CAS-conflicted — bounded retry exhausted; the
+        # next post-cycle pass tries again with fresh truth
+        self._count("exhausted")
+        return False

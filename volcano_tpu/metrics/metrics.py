@@ -425,6 +425,49 @@ def observe_watch_batch(size: int) -> None:
     ).observe(size)
 
 
+# ---- sharded scheduler federation (volcano_tpu/federation) ----
+# N scheduler processes each own a disjoint node shard via CAS leases;
+# jobs that fail to place on their home shard spill over via optimistic
+# CAS binds.  These four are the federation's vital signs: slice size,
+# spillover pressure (the shard-hash-skew signal), ownership churn, and
+# the lease plane's health.
+
+
+def update_shard_nodes_owned(count: int) -> None:
+    """volcano_shard_nodes_owned: nodes this scheduler currently owns
+    through its shard leases (the slice the cache/pack planes cover)."""
+    registry.set_gauge(f"{_NAMESPACE}_shard_nodes_owned", {}, count)
+
+
+def register_spillover_bind(result: str) -> None:
+    """volcano_spillover_binds_total{result}: cross-shard optimistic
+    CAS bind outcomes.  result ∈ {bound, conflict, exhausted, no-fit,
+    lost-race, error} — conflicts are the Omega model working as
+    intended; a high no-fit/exhausted rate means the cluster (not just
+    the home shard) is full or the shard hash is skewed."""
+    registry.inc(
+        f"{_NAMESPACE}_spillover_binds_total", {"result": result}
+    )
+
+
+def register_shard_rebalance(cause: str) -> None:
+    """volcano_shard_rebalances_total{cause}: shard ownership moved.
+    cause ∈ {expiry (absorbed a dead member's slice), join (claimed a
+    free slice), release (shed a slice for a joining member)}."""
+    registry.inc(
+        f"{_NAMESPACE}_shard_rebalances_total", {"cause": cause}
+    )
+
+
+def observe_shard_lease_renew(seconds: float) -> None:
+    """volcano_shard_lease_renew_latency_milliseconds: read-modify-CAS
+    round trip of one successful shard-map renew tick — creeping toward
+    the lease duration is the early warning before ownership flaps."""
+    registry.histogram(
+        f"{_NAMESPACE}_shard_lease_renew_latency_milliseconds", {}
+    ).observe(seconds * 1e3)
+
+
 # ---- TPU-build additions: per-kernel phase timings ----
 
 def update_kernel_duration(phase: str, seconds: float) -> None:

@@ -34,7 +34,7 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from volcano_tpu import actions as _actions  # noqa: F401 — registers actions
 from volcano_tpu import plugins as _plugins  # noqa: F401 — registers plugin builders
@@ -112,6 +112,11 @@ class Scheduler:
         #: change), or None
         self._full_cause: Optional[str] = None  # guarded-by: self._wake
         self._listener_attached = False
+        #: post-cycle hook, invoked after every run_once outside the
+        #: session (the federation spillover pass hangs here — work that
+        #: must see the cycle's outcome but never run concurrently with
+        #: a session).  Exceptions are logged, never kill the loop.
+        self.post_cycle: Optional[Callable[[], None]] = None
         #: observability for tests and bench/loadgen.py
         self.micro_cycles_run = 0
         self.full_cycles_run = 0
@@ -303,6 +308,12 @@ class Scheduler:
             metrics.update_micro_cycle_duration(elapsed)
         else:
             self.full_cycles_run += 1
+        if self.post_cycle is not None:
+            try:
+                self.post_cycle()
+            except Exception as e:  # noqa: BLE001 — a hook failure must
+                # not take the scheduling loop down with it
+                log.error("post-cycle hook failed: %s", e)
 
     def run_cycle_window(self, max_cycles: Optional[int] = None) -> int:
         """One full-cycle period of the event-driven loop: a full cycle
