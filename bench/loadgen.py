@@ -85,6 +85,7 @@ class LoadgenTopology:
 
         self.api = APIServer()
         self.bus = BusServer(self.api).start()
+        self.bus_address = f"tcp://127.0.0.1:{self.bus.port}"
         # arrivals land on the in-process store (the generator is
         # colocated with the apiserver, off the measured path) and reach
         # the SCHEDULER over the real TCP watch stream — the measured
@@ -137,9 +138,7 @@ class LoadgenTopology:
         from volcano_tpu.client import SchedulerClient
         from volcano_tpu.scheduler.scheduler import Scheduler
 
-        self.sched_remote = RemoteAPIServer(
-            f"tcp://127.0.0.1:{self.bus.port}", timeout=10.0
-        )
+        self.sched_remote = RemoteAPIServer(self.bus_address, timeout=10.0)
         assert self.sched_remote.wait_ready(10.0)
         self.cache = SchedulerCache(
             client=SchedulerClient(self.sched_remote),
@@ -329,6 +328,209 @@ class FederatedTopology(LoadgenTopology):
         for f in self._logs:
             f.close()
         self.bus.stop()
+
+
+class ReplicatedBusTopology(LoadgenTopology):
+    """The replicated persistent bus under load: N real
+    ``vtpu-apiserver`` OS processes (WAL dirs, leader election, quorum
+    commit) instead of the in-process store, with the harness's own
+    clients — submission, audit watch, the scheduler — dialing the full
+    endpoint list.  ``--kill-apiserver-after`` SIGKILLs the LEADER mid
+    open-loop stream; the drill passes only if a follower promotes,
+    every submitted pod still binds (zero lost acknowledged binds), and
+    no pod is ever re-bound."""
+
+    def __init__(self, n_nodes: int, node_cpu: int, conf_path: str,
+                 period: float, debounce_ms: float, n_replicas: int = 3,
+                 lease_ttl: float = 1.0, micro_cycles: bool = True,
+                 startup_timeout: float = 120.0):
+        import socket as _socket
+        import subprocess
+
+        from volcano_tpu.bus.remote import RemoteAPIServer
+        from volcano_tpu.client import ADDED, KubeClient, MODIFIED, VolcanoClient
+        from volcano_tpu.client.apiserver import ApiError
+
+        def free_port():
+            with _socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        self.n_replicas = n_replicas
+        self.lease_ttl = lease_ttl
+        ports = [free_port() for _ in range(n_replicas)]
+        self.endpoints = [f"tcp://127.0.0.1:{p}" for p in ports]
+        self.bus_address = ",".join(self.endpoints)
+        self._data_root = tempfile.mkdtemp(prefix="loadgen-bus-")
+        self.procs = []
+        self._logs = []
+        for i, port in enumerate(ports):
+            log_path = os.path.join(tempfile.gettempdir(),
+                                    f"loadgen-apiserver{i}.log")
+            logf = open(log_path, "w")  # noqa: SIM115 — held for the proc
+            self._logs.append(logf)
+            self.procs.append(subprocess.Popen(
+                [sys.executable, "-m", "volcano_tpu.cmd.apiserver",
+                 "--listen-host", "127.0.0.1", "--port", str(port),
+                 "--listen-port", "0",
+                 "--data-dir", os.path.join(self._data_root, f"r{i}"),
+                 "--replicas", self.bus_address,
+                 "--replica-index", str(i),
+                 "--repl-lease-ttl", str(lease_ttl)],
+                stdout=logf, stderr=subprocess.STDOUT,
+                env=dict(os.environ),
+            ))
+
+        # the audit/submission client dials the endpoint list REVERSED:
+        # the staggered election makes replica 0 the bootstrap leader
+        # (the kill target), and an audit watch riding the killed
+        # replica would stamp every pre-kill bind at watch-RESUME time
+        # — a measurement artifact, not system latency.  Watching from
+        # a follower measures honestly: followers stream commit-gated
+        # events continuously through the failover.
+        self.api = RemoteAPIServer(
+            ",".join(reversed(self.endpoints)), timeout=15.0
+        )
+        if not self.api.wait_ready(startup_timeout):
+            raise RuntimeError("replicated apiserver group never came up")
+        self.kube = KubeClient(self.api)
+        self.vc = VolcanoClient(self.api)
+
+        # seeding waits out the election + quorum window
+        deadline = time.monotonic() + startup_timeout
+        while True:
+            try:
+                self.vc.create_queue(_build_queue("default"))
+                break
+            except ApiError as e:
+                if "already exists" in str(e):
+                    break
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.25)
+        for i in range(n_nodes):
+            self.kube.create_node(
+                _build_node(f"n{i:04d}", {"cpu": str(node_cpu),
+                                          "memory": "256Gi"})
+            )
+
+        self.bind_ts: Dict[str, float] = {}
+        self.rebinds = 0
+        self._bind_lock = threading.Lock()
+
+        def audit(event, old, new):
+            if event not in (ADDED, MODIFIED) or new is None:
+                return
+            if not new.spec.node_name:
+                return
+            key = f"{new.metadata.namespace}/{new.metadata.name}"
+            with self._bind_lock:
+                self.bind_ts.setdefault(key, time.time())
+                if (
+                    old is not None and old.spec.node_name
+                    and old.spec.node_name != new.spec.node_name
+                ):
+                    self.rebinds += 1
+
+        self.api.watch("Pod", audit, send_initial=False)
+
+        self.complete_after_s = 0.0
+        self._group_size: Dict[str, int] = {}
+        self._reaper_stop = threading.Event()
+        self._reaper = threading.Thread(
+            target=self._reap_loop, name="loadgen-reaper", daemon=True
+        )
+        self._reaper.start()
+        self._start_scheduler(conf_path, period, debounce_ms, micro_cycles)
+
+    def submit_job(self, name: str, tasks: int, cpu: str):
+        """Bounded, IDEMPOTENT retry across the failover window: an
+        arrival landing mid-election is retried rather than crashing
+        the open-loop generator (its lag still counts as system latency
+        — the clock started at the scheduled arrival instant), and a
+        retry after an ambiguous failure treats AlreadyExists as
+        success (the earlier attempt committed)."""
+        from volcano_tpu.client.apiserver import AlreadyExistsError, ApiError
+
+        # the budget must cover one full client timeout (a call parked
+        # on a mid-reconnect connection) PLUS the election window
+        deadline = time.monotonic() + max(self.lease_ttl * 10, 30.0)
+
+        def create(fn, *args):
+            while True:
+                try:
+                    fn(*args)
+                    return
+                except AlreadyExistsError:
+                    return  # an ambiguous earlier attempt committed
+                except ApiError:
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.1)
+
+        create(self.vc.create_pod_group, _build_pod_group("ns", name, tasks))
+        self._group_size[name] = tasks
+        keys = []
+        for i in range(tasks):
+            pod_name = f"{name}-t{i}"
+            create(
+                self.kube.create_pod,
+                _build_pod("ns", pod_name,
+                           {"cpu": cpu, "memory": "1Gi"}, group=name),
+            )
+            keys.append(f"ns/{pod_name}")
+        return keys
+
+    def leader_index(self):
+        from volcano_tpu.bus.replication import probe_status
+
+        for i, url in enumerate(self.endpoints):
+            st = probe_status(url)
+            if st is not None and st.get("role") == "leader":
+                return i
+        return None
+
+    def kill_leader(self) -> str:
+        idx = self.leader_index()
+        if idx is None:
+            return "<no leader found>"
+        self.procs[idx].kill()
+        self.procs[idx].wait(timeout=10)
+        return f"replica-{idx}"
+
+    def bus_report(self) -> dict:
+        from volcano_tpu.bus.replication import probe_status
+
+        roles = {}
+        for i, url in enumerate(self.endpoints):
+            st = probe_status(url)
+            roles[f"replica-{i}"] = (
+                st.get("role") if st is not None else "dead"
+            )
+        with self._bind_lock:
+            rebinds = self.rebinds
+        return {"replicas": self.n_replicas, "roles": roles,
+                "rebinds": rebinds}
+
+    def close(self):
+        self._reaper_stop.set()
+        self._reaper.join(timeout=5)
+        self.scheduler.stop()
+        self._thread.join(timeout=15)
+        self.cache.stop_commit_plane()
+        self.sched_remote.close()
+        self.api.close()
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — escalate to SIGKILL
+                p.kill()
+                p.wait(timeout=5)
+        for f in self._logs:
+            f.close()
 
 
 # ---- builders (bench is standalone: no tests/ import) ----
@@ -524,6 +726,15 @@ def run_loadgen(args) -> dict:
                 lease_duration=args.shard_lease_duration,
                 micro_cycles=not args.no_micro_cycles,
             )
+        elif args.apiserver_replicas > 0:
+            topo = ReplicatedBusTopology(
+                n_nodes=args.nodes, node_cpu=args.node_cpu,
+                conf_path=conf_path, period=args.period,
+                debounce_ms=args.debounce_ms,
+                n_replicas=args.apiserver_replicas,
+                lease_ttl=args.repl_lease_ttl,
+                micro_cycles=not args.no_micro_cycles,
+            )
         else:
             topo = LoadgenTopology(
                 n_nodes=args.nodes, node_cpu=args.node_cpu,
@@ -564,12 +775,30 @@ def run_loadgen(args) -> dict:
                 )
                 killer.daemon = True
                 killer.start()
+            if args.apiserver_replicas > 0 and args.kill_apiserver_after > 0:
+                # the bus-HA drill: SIGKILL the apiserver LEADER
+                # mid-stream; a follower must promote within one lease
+                # TTL and the drain still requires every pod to bind
+                # (zero lost acknowledged binds, zero re-binds)
+                killed = {}
+                killer = threading.Timer(
+                    args.kill_apiserver_after,
+                    lambda: killed.setdefault("id", topo.kill_leader()),
+                )
+                killer.daemon = True
+                killer.start()
             report = run_phase(
                 topo, rate, args.duration, args.tasks_per_job, args.cpu,
                 args.drain_timeout, label=label,
             )
             if hasattr(topo, "scheduler"):
                 report.update(_cycle_mix(topo))
+            if args.apiserver_replicas > 0:
+                report["bus_ha"] = topo.bus_report()
+                if args.kill_apiserver_after > 0:
+                    report["bus_ha"]["killed_leader"] = killed.get(
+                        "id", "<kill timer never fired>"
+                    )
             if args.shards > 0:
                 report["federation"] = topo.shard_report()
                 if args.kill_shard_after > 0:
@@ -663,6 +892,18 @@ def main(argv=None) -> int:
                    "per-shard + aggregate percentiles (0 = the "
                    "single-scheduler topology)")
     p.add_argument("--shard-lease-duration", type=float, default=2.0)
+    p.add_argument("--apiserver-replicas", type=int, default=0,
+                   help="replicated persistent bus: spawn N real "
+                   "vtpu-apiserver OS processes (WAL dirs, leader "
+                   "election, quorum-acked writes) instead of the "
+                   "in-process store (0 = in-process)")
+    p.add_argument("--repl-lease-ttl", type=float, default=1.0,
+                   help="apiserver leader-liveness lease TTL")
+    p.add_argument("--kill-apiserver-after", type=float, default=0.0,
+                   help="SIGKILL the apiserver LEADER this many seconds "
+                   "into the measured stream (bus HA drill: a follower "
+                   "must promote within one lease TTL, every pod must "
+                   "still bind, and no pod may be re-bound)")
     p.add_argument("--kill-shard-after", type=float, default=0.0,
                    help="SIGKILL shard member 0 this many seconds into "
                    "the measured stream (federation chaos: survivors "
@@ -693,6 +934,20 @@ def main(argv=None) -> int:
         print("LOADGEN FAIL: federation run is not policy-equivalent: "
               f"{r.get('policy_violations')}", file=sys.stderr)
         return 1
+    if args.apiserver_replicas > 0:
+        ha = r.get("bus_ha", {})
+        if ha.get("rebinds", 0) != 0:
+            print(f"LOADGEN FAIL: {ha['rebinds']} pods were re-bound "
+                  "across the failover (duplicate acknowledged binds)",
+                  file=sys.stderr)
+            return 1
+        if args.kill_apiserver_after > 0:
+            roles = list(ha.get("roles", {}).values())
+            if roles.count("leader") != 1:
+                print(f"LOADGEN FAIL: no single promoted leader after "
+                      f"the kill (roles: {ha.get('roles')})",
+                      file=sys.stderr)
+                return 1
     return 0
 
 

@@ -122,8 +122,19 @@ def test_reference_catalog_names_render():
     metrics.register_job_retries("j")
     metrics.register_schedule_attempt("scheduled")
     metrics.update_kernel_duration("pack", 0.001)
+    metrics.observe_wal_fsync(0.001)
+    metrics.update_wal_size(1024)
+    metrics.update_repl_lag(2)
+    metrics.update_repl_role("leader")
+    metrics.register_bus_recovery("snapshot")
+    metrics.register_bus_recovery("wal_tail")
     out = metrics.registry.render()
     for name in (
+        "volcano_wal_fsync_latency_milliseconds",
+        "volcano_wal_size_bytes",
+        "volcano_repl_lag_entries",
+        "volcano_repl_role",
+        "volcano_bus_recoveries_total",
         "volcano_plugin_scheduling_latency_microseconds",
         "volcano_action_scheduling_latency_microseconds",
         "volcano_e2e_scheduling_latency_milliseconds",

@@ -16,9 +16,15 @@ point for the standalone build:
 Run the daemon with ``python -m volcano_tpu.cmd.apiserver``.
 """
 
-from volcano_tpu.bus.protocol import BusError, BusTimeoutError, parse_bus_url
+from volcano_tpu.bus.protocol import (
+    BusError,
+    BusTimeoutError,
+    parse_bus_endpoints,
+    parse_bus_url,
+)
 from volcano_tpu.bus.remote import RemoteAPIServer
 from volcano_tpu.bus.server import BusServer
+from volcano_tpu.bus.wal import PersistentAPIServer
 
 
 def connect_bus(bus: str = "", timeout: float = 10.0, wait: float = 30.0):
@@ -26,8 +32,11 @@ def connect_bus(bus: str = "", timeout: float = 10.0, wait: float = 30.0):
     vtctl, local_up): an address returns a ``RemoteAPIServer`` that is
     already reachable — or raises ``BusError`` after ``wait`` seconds,
     so misconfiguration fails loudly at startup instead of as an
-    endless reconnect loop behind a green healthz.  Empty returns a
-    standalone in-process ``APIServer``."""
+    endless reconnect loop behind a green healthz.  The address may be
+    a comma-separated endpoint list (``tcp://a,tcp://b`` — replicated
+    apiservers); the client dials across the list and fails over on
+    replica death.  Empty returns a standalone in-process
+    ``APIServer``."""
     if bus:
         api = RemoteAPIServer(bus, timeout=timeout)
         if not api.wait_ready(wait):
@@ -43,7 +52,9 @@ __all__ = [
     "BusError",
     "BusServer",
     "BusTimeoutError",
+    "PersistentAPIServer",
     "RemoteAPIServer",
     "connect_bus",
+    "parse_bus_endpoints",
     "parse_bus_url",
 ]

@@ -29,7 +29,7 @@ from __future__ import annotations
 import json
 import socket
 import struct
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from volcano_tpu.apis import batch, core, scheduling, scheme
 from volcano_tpu.apis import bus as apis_bus
@@ -52,15 +52,23 @@ MAGIC = b"VBUS"
 #: adds the ``cas_bind`` op: one optimistic-concurrency binding write
 #: (bind iff the pod is still unbound and its resourceVersion matches)
 #: — the federation spillover primitive, one round trip instead of a
-#: get + CAS update.  The frame LAYOUT is unchanged throughout, so
+#: get + CAS update.  v5 adds the replicated-bus surface: ``bus_status``
+#: (role / leader / term / WAL + replication introspection — the
+#: ``vtctl bus status`` op) and the leader/follower log-shipping ops
+#: ``repl_append`` / ``repl_snapshot`` / ``repl_commit``
+#: (bus/replication.py).  The frame LAYOUT is unchanged throughout, so
 #: frames are STAMPED with MIN_VERSION — a v1 peer accepts every frame
 #: at the framing layer, and a newer client talking to an older server
 #: detects the unknown op from the typed error and falls back
 #: (per-object binds for ``commit_batch``; a plain ``watch`` for
-#: ``watch_batch``; get + CAS ``update`` for ``cas_bind`` — bus/
-#: remote.py).  VERSION is the protocol revision this build speaks;
-#: receivers accept [MIN_VERSION, VERSION].
-VERSION = 4
+#: ``watch_batch``; get + CAS ``update`` for ``cas_bind``; a degraded
+#: ``role: unknown`` payload for ``bus_status`` — bus/remote.py.  An
+#: old peer cannot be a replica at all, so the repl ops have no
+#: fallback to degrade to: a replica group must be version-homogeneous
+#: and a follower simply logs and retries against an old leader).
+#: VERSION is the protocol revision this build speaks; receivers
+#: accept [MIN_VERSION, VERSION].
+VERSION = 5
 #: oldest frame version this build still decodes — and the version
 #: outgoing frames carry, since the layout has not changed since v1
 MIN_VERSION = 1
@@ -120,6 +128,10 @@ OP_VERSIONS: Dict[str, int] = {
     "commit_batch": 2,
     "watch_batch": 3,
     "cas_bind": 4,
+    "bus_status": 5,
+    "repl_append": 5,
+    "repl_snapshot": 5,
+    "repl_commit": 5,
 }
 
 #: wire error name → exception class; unknown names fall back to ApiError
@@ -182,6 +194,21 @@ def parse_bus_url(url: str) -> Tuple[str, int]:
     if not sep or not port.isdigit():
         raise ValueError(f"bus address needs host:port, got {url!r}")
     return host or "127.0.0.1", int(port)
+
+
+def parse_bus_endpoints(urls: str) -> List[Tuple[str, int]]:
+    """``tcp://a:1,tcp://b:2,...`` → [(host, port), ...] — the
+    replicated-apiserver form of ``--bus``: a client dials the list in
+    order until one answers, and redials across it on failure, so a
+    dead replica never strands a daemon."""
+    out: List[Tuple[str, int]] = []
+    for part in urls.split(","):
+        part = part.strip()
+        if part:
+            out.append(parse_bus_url(part))
+    if not out:
+        raise ValueError(f"bus endpoint list is empty: {urls!r}")
+    return out
 
 
 def encode_payload(payload: dict) -> bytes:

@@ -100,8 +100,19 @@ class RemoteAPIServer:
         reconnect_min: float = 0.05,
         reconnect_max: float = 2.0,
     ):
-        self.host, self.tcp_port = protocol.parse_bus_url(address)
-        self.address = f"tcp://{self.host}:{self.tcp_port}"
+        #: ``address`` may be a comma-separated endpoint LIST
+        #: (``tcp://a,tcp://b,...``) — the replicated-apiserver form:
+        #: the client dials entries in order until one answers and
+        #: rotates across them on connection loss, so a dead replica
+        #: never strands a daemon.  Reads/watches are served wherever
+        #: we land (followers included); writes are proxied server-side
+        #: to the leader.
+        self.endpoints = [
+            f"tcp://{h}:{p}" for h, p in protocol.parse_bus_endpoints(address)
+        ]
+        self._endpoint_idx = 0
+        self.host, self.tcp_port = protocol.parse_bus_url(self.endpoints[0])
+        self.address = self.endpoints[0]
         self.timeout = timeout
         self.reconnect_min = reconnect_min
         self.reconnect_max = reconnect_max
@@ -136,6 +147,15 @@ class RemoteAPIServer:
         #: set once a server rejects the v4 ``cas_bind`` op — spillover
         #: binds then degrade to the get + CAS-update equivalent
         self._no_cas_bind = False
+        #: set once a server rejects the v5 ``bus_status`` op — status
+        #: queries then answer a degraded ``role: unknown`` payload
+        self._no_bus_status = False
+        #: this client must sit on the LEADER (set by
+        #: register_admission: webhook reviews are forwarded by the
+        #: server that runs the store transaction, which is always the
+        #: leader) — on connect to a follower it redials at the
+        #: follower-reported leader address
+        self._must_lead = False
 
         self._ctl: "queue.Queue[tuple]" = queue.Queue()
         self._dispatch_q: "queue.Queue[Optional[tuple]]" = queue.Queue()
@@ -160,14 +180,23 @@ class RemoteAPIServer:
         """Block until the bus is reachable (daemon startup gate)."""
         return self._connected.wait(timeout)
 
+    def _current_endpoint(self) -> Tuple[str, int]:
+        url = self.endpoints[self._endpoint_idx % len(self.endpoints)]
+        self.address = url
+        return protocol.parse_bus_url(url)
+
     def _conn_loop(self) -> None:
         backoff = self.reconnect_min
         while not self._closed:
             try:
                 sock = socket.create_connection(
-                    (self.host, self.tcp_port), timeout=self.timeout
+                    self._current_endpoint(), timeout=self.timeout
                 )
             except OSError:
+                # rotate to the next replica before backing off — a
+                # dead endpoint must not serialize the whole list
+                # behind its own backoff ladder
+                self._endpoint_idx += 1
                 jitter = random.uniform(0, backoff * 0.25)
                 time.sleep(backoff + jitter)
                 backoff = min(backoff * 2, self.reconnect_max)
@@ -185,12 +214,22 @@ class RemoteAPIServer:
                 log.info("bus %s reconnected", self.address)
             self._ever_connected = True
             self._connected.set()
+            if self._must_lead and not self._leader_check():
+                # connected to a follower while this client must sit on
+                # the leader (admission endpoint): redial at the leader
+                self._connected.clear()
+                self._teardown_socket(sock)
+                self._fail_pending(BusError("redialing at the bus leader"))
+                time.sleep(min(0.2, self.reconnect_max))
+                continue
             self._resync_session()
             # serve control messages until the reader reports loss
             while not self._closed:
                 item = self._ctl.get()
                 if item[0] == "disconnect":
                     break
+                if item[0] == "redial":
+                    break  # e.g. leader moved — reconnect at the hint
                 if item[0] == "resync":
                     self._resync_session()
                 if item[0] == "unsubscribe":
@@ -203,6 +242,26 @@ class RemoteAPIServer:
             self._connected.clear()
             self._teardown_socket(sock)
             self._fail_pending(BusError("bus connection lost"))
+
+    def _leader_check(self) -> bool:
+        """True when the connected peer can host this client (leader,
+        standalone, or a pre-v5 server).  On a follower: point the
+        endpoint cursor at the reported leader and return False."""
+        try:
+            status = self.bus_status()
+        except (ApiError, OSError):
+            return True  # can't tell — stay; calls will surface errors
+        if status.get("role") != "follower":
+            return True
+        leader = status.get("leader")
+        if not leader:
+            return False  # election in progress — retry shortly
+        if leader not in self.endpoints:
+            self.endpoints.append(leader)
+        self._endpoint_idx = self.endpoints.index(leader)
+        log.info("bus %s is a follower; redialing at leader %s",
+                 self.address, leader)
+        return False
 
     def _resync_session(self) -> None:
         """After (re)connect: re-register admission endpoints, then
@@ -220,6 +279,10 @@ class RemoteAPIServer:
             except (ApiError, OSError) as e:
                 log.error("bus admission re-register %s/%s failed: %s",
                           kind, operation, e)
+                if "not leader" in str(e):
+                    # this peer became a follower — redial at the leader
+                    self._ctl.put(("redial",))
+                    return
                 failed = True
         with self._watch_lock:
             states = list(self._watches.values())
@@ -404,6 +467,28 @@ class RemoteAPIServer:
         except (BusError, OSError):
             return False
 
+    def bus_status(self) -> dict:
+        """Bus durability/replication status (protocol v5): role, leader
+        identity, term, WAL/snapshot stats, follower lag — the payload
+        ``vtctl bus status`` renders.  A pre-v5 server answers ``unknown
+        bus op``; the client then degrades PERMANENTLY (per connection
+        lifetime) to a ``role: unknown`` payload — status is
+        observability, never correctness."""
+        if not self._no_bus_status:
+            try:
+                return self._call({"op": "bus_status"})
+            except BusError:
+                raise  # transport failure — NOT a capability signal
+            except ApiError as e:
+                if "unknown bus op" not in str(e):
+                    raise
+                log.warning(
+                    "bus %s does not speak bus_status (old peer)",
+                    self.address,
+                )
+                self._no_bus_status = True
+        return {"role": "unknown", "persistent": False}
+
     def create(self, obj):
         resp = self._call({"op": "create", "object": protocol.encode_obj(obj)})
         return protocol.decode_obj(resp["object"])
@@ -556,6 +641,9 @@ class RemoteAPIServer:
         key = (kind, operation)
         first = key not in self._admission
         self._admission.setdefault(key, []).append(hook)
+        #: reviews are forwarded by the leader — from now on this client
+        #: chases the leader across reconnects (replicated apiservers)
+        self._must_lead = True
         if first and self._connected.is_set():
             try:
                 self._call({"op": "register_admission", "kind": kind,
@@ -567,7 +655,13 @@ class RemoteAPIServer:
                 # an unregistered webhook fails OPEN on the server side
                 log.error("bus admission register %s/%s failed: %s",
                           kind, operation, e)
-                self._ctl.put(("resync",))
+                if "not leader" in str(e):
+                    # we sit on a follower: break the connection so the
+                    # reconnect (with _must_lead set) lands on the
+                    # leader, where the resync replays the registration
+                    self._ctl.put(("redial",))
+                else:
+                    self._ctl.put(("resync",))
 
     def watch(self, kind: str, handler: WatchHandler,
               send_initial: bool = True) -> None:

@@ -1,0 +1,801 @@
+"""Single-leader log shipping between apiserver replicas (ROADMAP 4b).
+
+The durability layer (``bus/wal.py``) makes one ``vtpu-apiserver``
+crash-safe; this module makes the bus *highly available*: N replicas,
+one leader taking every write, followers applying the leader's WAL
+records to their own durable stores and serving reads/watches locally.
+
+Protocol (VBUS v5 request ops, follower → leader, pull-based):
+
+* ``repl_append`` — long-poll for records after ``(seq, chain)``.  The
+  leader verifies the follower's position against its retained record
+  window by comparing the CRC chain value (each record's chain is
+  ``crc32(record_bytes, prev_chain)``); a mismatch or an out-of-window
+  cursor answers ``snapshot_needed`` instead of shipping a divergent
+  suffix.  The request's ``after`` doubles as a cumulative ack.
+* ``repl_snapshot`` — full store snapshot for bootstrap or resync.
+* ``repl_commit`` — explicit ack after applying a batch: the follower
+  reports its applied seq, the leader recomputes the commit point and
+  returns it.  This is what makes quorum acks prompt instead of
+  waiting for the next poll cycle.
+
+Commit rule: a write is acknowledged only after the leader's WAL fsync
+AND, with ``replica_count >= 2``, after a majority of replicas
+(leader included) hold the record — ``commit_seq`` is the quorum-th
+highest applied seq.  Watch notifications are withheld until the
+commit point everywhere (leader and followers), so no watcher —
+local or remote — ever observes an event a failover could roll back.
+That is exactly what lets a client's watch cursor survive leader death:
+committed seqs exist on a majority, the promotion rule picks the
+most-advanced reachable survivor, and the epoch is replication-group-
+wide, so ``resume_seq`` validates against the new leader and
+``bus_relists_total`` stays flat.
+
+Election: membership is the static ``--replicas`` endpoint list.  A
+follower that loses its leader (pull failure persisting past the lease
+TTL) probes every peer's ``bus_status``; it promotes itself only when
+a majority of replicas is reachable and it is the most advanced —
+ordered by ``(term, applied seq, -index)`` — otherwise it follows
+whoever is.  Promotion bumps the persisted term; a deposed leader
+rejoining sees the higher term and demotes.  No partition-tolerant
+consensus is claimed (see the README's honest-gaps entry): below a
+majority the group refuses promotion and writes stall rather than
+risk acknowledged-write loss.
+
+Write routing: a follower's BusServer proxies write ops (create /
+update / update_status / delete / cas_bind / commit_batch / get) to
+the leader over the manager's client connection — clients connected to
+a follower keep working through it, while watches and lists are served
+from the follower's local store.
+
+Fault points: ``repl.drop`` (a shipment batch is dropped on the
+leader — the follower re-pulls), ``repl.lag`` (injected apply latency
+on the follower), ``bus.leader_kill`` (crash-stop the leader mid-
+commit — wired through ``PersistentAPIServer.kill_hook``).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+from volcano_tpu.bus import protocol
+from volcano_tpu.bus.protocol import BusError
+from volcano_tpu.bus.wal import PersistentAPIServer
+from volcano_tpu.client.apiserver import ApiError
+from volcano_tpu.metrics import metrics
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: records the leader retains in memory for follower catch-up; a
+#: follower further behind than this re-syncs via repl_snapshot
+_RETAIN = 4096
+
+#: per-pull shipment cap (frames stay bounded like _WATCH_BATCH_MAX)
+_PULL_MAX = 256
+
+
+def quorum_of(replica_count: int) -> int:
+    """Majority including the leader; 1 when the group is a singleton."""
+    return replica_count // 2 + 1 if replica_count >= 2 else 1
+
+
+class ReplicationCoordinator:
+    """Leader-side record outbox + quorum tracking.
+
+    ``leader_append`` is called by the store's commit path (under the
+    store lock); ``pull``/``ack`` are called from bus request-handler
+    threads serving followers and touch only this object's condition
+    lock — the store lock is never needed here, so a leader parked in
+    ``wait_commit`` cannot deadlock the acks that will release it."""
+
+    def __init__(self, replica_count: int, identity: str,
+                 base_seq: int, base_chain: int,
+                 commit_timeout: float = 10.0):
+        self.replica_count = replica_count
+        self.identity = identity
+        self.commit_timeout = commit_timeout
+        self._cv = threading.Condition()
+        #: retained tail: {"seq", "term", "chain", "payload", "ts"} —
+        #: seq is the LAST event seq the record produced
+        self._records: List[dict] = []  # guarded-by: self._cv
+        self._base_seq = base_seq  # guarded-by: self._cv
+        self._base_chain = base_chain  # guarded-by: self._cv
+        self._last_seq = base_seq  # guarded-by: self._cv
+        self._last_ts = 0.0  # guarded-by: self._cv
+        self._commit_seq = base_seq  # guarded-by: self._cv
+        #: follower id → {"acked": seq, "seen": monotonic ts}
+        self._followers: Dict[str, dict] = {}  # guarded-by: self._cv
+        #: set by shutdown(): in-flight commit waits abort immediately
+        #: (a stopping or deposed leader must not park writers — and
+        #: must not park its own store lock — for the full timeout)
+        self._dead = False  # guarded-by: self._cv
+        #: late-commit notify hook (store.flush_committed).  Invoked
+        #: ONLY from the dedicated flusher thread below — never from an
+        #: ack request thread: the hook takes the store lock, and an
+        #: ack thread starving behind a stream of committers would
+        #: stall the follower waiting on its repl_commit response,
+        #: which stalls the quorum, which wedges the leader (observed
+        #: as a whole-group stall under loadgen before this existed).
+        self._on_commit = None
+        self._flusher: Optional[threading.Thread] = None
+
+    def start_flusher(self, on_commit) -> None:
+        """Install the late-commit flush hook on its own thread.  The
+        normal path needs no flush here — a committing writer delivers
+        its own notifications after ``wait_commit`` — so this thread
+        only picks up commits whose writer timed out (or follower-side
+        gaps), and its lock waits block nobody."""
+        self._on_commit = on_commit
+        self._flusher = threading.Thread(
+            target=self._flush_loop,
+            name=f"vtpu-repl-flush-{self.identity}", daemon=True,
+        )
+        self._flusher.start()
+
+    def _flush_loop(self) -> None:
+        last = 0
+        while True:
+            with self._cv:
+                while not self._dead and self._commit_seq <= last:
+                    self._cv.wait(1.0)
+                if self._dead:
+                    return
+                commit = self._commit_seq
+            self._on_commit(commit)
+            last = commit
+
+    # ---- leader write path (store lock held by the caller) ----
+
+    def leader_append(self, seq: int, term: int, chain: int,
+                      payload: bytes, ts: float) -> None:
+        with self._cv:
+            self._records.append({
+                "seq": seq, "term": term, "chain": chain,
+                "payload": payload, "ts": ts,
+            })
+            if len(self._records) > _RETAIN:
+                dropped = self._records.pop(0)
+                self._base_seq = dropped["seq"]
+                self._base_chain = dropped["chain"]
+            self._last_seq = seq
+            self._last_ts = ts
+            self._recompute_commit()
+            self._cv.notify_all()
+
+    def wait_commit(self, seq: int, timeout: Optional[float] = None) -> bool:
+        timeout = self.commit_timeout if timeout is None else timeout
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._commit_seq < seq:
+                if self._dead:
+                    return False
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            return True
+
+    def shutdown(self) -> None:
+        """Abort every parked commit wait (leader stopping or deposed)."""
+        with self._cv:
+            self._dead = True
+            self._cv.notify_all()
+
+    def _recompute_commit(self) -> None:
+        # requires-lock: self._cv
+        acked = sorted(
+            [self._last_seq] + [f["acked"] for f in self._followers.values()],
+            reverse=True,
+        )
+        k = quorum_of(self.replica_count)
+        if len(acked) >= k:
+            new_commit = acked[k - 1]
+            if new_commit > self._commit_seq:
+                self._commit_seq = new_commit
+
+    # ---- follower-facing ops (request-handler threads) ----
+
+    def ack(self, follower_id: str, acked_seq: int) -> int:
+        """Record a follower's applied seq; returns the commit point."""
+        with self._cv:
+            entry = self._followers.setdefault(
+                follower_id, {"acked": 0, "seen": 0.0}
+            )
+            if acked_seq > entry["acked"]:
+                entry["acked"] = acked_seq
+            entry["seen"] = time.monotonic()
+            self._recompute_commit()
+            commit = self._commit_seq
+            self._cv.notify_all()  # wakes parked writers AND the flusher
+        return commit
+
+    def pull(self, follower_id: str, after_seq: int, after_chain: int,
+             wait_s: float, max_records: int = _PULL_MAX) -> dict:
+        """One ``repl_append`` long-poll.  The cursor doubles as an ack."""
+        from volcano_tpu import faults
+
+        deadline = time.monotonic() + max(0.0, min(wait_s, 30.0))
+        with self._cv:
+            entry = self._followers.setdefault(
+                follower_id, {"acked": 0, "seen": 0.0}
+            )
+            if after_seq > entry["acked"]:
+                entry["acked"] = after_seq
+            entry["seen"] = time.monotonic()
+            self._recompute_commit()
+            self._cv.notify_all()
+            # cursor validation against the retained window + CRC chain:
+            # behind the window, AHEAD of the leader (a divergent
+            # uncommitted suffix from a dead term), or a chain mismatch
+            # all mean the follower's log is not a prefix of ours —
+            # re-sync via snapshot instead of shipping a wrong suffix
+            if after_seq < self._base_seq or after_seq > self._last_seq:
+                return {"snapshot_needed": True}
+            expected = self._chain_at(after_seq)
+            if expected is None or expected != after_chain:
+                return {"snapshot_needed": True}
+            while self._last_seq <= after_seq:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            records = [
+                r for r in self._records if r["seq"] > after_seq
+            ][:max_records]
+            commit = self._commit_seq
+            last_seq = self._last_seq
+        fp = faults.get_plane()
+        if fp.enabled and records and fp.should("repl.drop"):
+            # the shipment is lost on the wire — the follower's next
+            # poll re-requests the same suffix (pure retransmission
+            # latency, never a gap: the cursor did not advance)
+            records = []
+        return {
+            "records": [
+                {"payload": r["payload"].decode(), "seq": r["seq"],
+                 "term": r["term"], "chain": r["chain"]}
+                for r in records
+            ],
+            "commit_seq": commit,
+            "leader_seq": last_seq,
+        }
+
+    def _chain_at(self, seq: int) -> Optional[int]:
+        # requires-lock: self._cv
+        if seq == self._base_seq:
+            return self._base_chain
+        for r in self._records:
+            if r["seq"] == seq:
+                return r["chain"]
+        return None
+
+    def commit_seq(self) -> int:
+        with self._cv:
+            return self._commit_seq
+
+    def follower_lags(self) -> Dict[str, dict]:
+        """Per-follower replication lag, entries + ms, derived purely
+        from stored state (no call-time clock) so ``vtctl bus status``
+        renders byte-identically across backends."""
+        with self._cv:
+            out = {}
+            for fid, f in self._followers.items():
+                lag_entries = max(0, self._last_seq - f["acked"])
+                lag_ms = 0.0
+                if lag_entries:
+                    acked_ts = self._base_ts_for(f["acked"])
+                    if acked_ts is not None and self._last_ts:
+                        lag_ms = round(
+                            max(0.0, (self._last_ts - acked_ts) * 1e3), 1
+                        )
+                out[fid] = {
+                    "acked_seq": f["acked"],
+                    "lag_entries": lag_entries,
+                    "lag_ms": lag_ms,
+                }
+            return out
+
+    def _base_ts_for(self, acked_seq: int) -> Optional[float]:
+        # requires-lock: self._cv
+        for r in self._records:
+            if r["seq"] > acked_seq:
+                return r["ts"]
+        return None
+
+    def max_lag_entries(self) -> int:
+        with self._cv:
+            if not self._followers:
+                return 0
+            return max(
+                max(0, self._last_seq - f["acked"])
+                for f in self._followers.values()
+            )
+
+
+def probe_status(url: str, timeout: float = 1.5) -> Optional[dict]:
+    """One-shot ``bus_status`` against a bare endpoint — the election
+    probe.  Returns None when the peer is unreachable or too old to
+    answer (an ``unknown bus op`` peer cannot be a v5 replica).  The
+    timeout is generous relative to the probe's cost (~1 RTT + a
+    status render): a loaded-but-alive peer that misses the window
+    reads as dead, and an election that keeps seeing phantom deaths
+    refuses to promote (below-quorum) or promotes spuriously — both
+    worse than a slower probe round."""
+    try:
+        host, port = protocol.parse_bus_url(url)
+        with socket.create_connection((host, port), timeout=timeout) as sock:
+            sock.settimeout(timeout)
+            protocol.send_frame(sock, protocol.T_REQ, 1, {"op": "bus_status"})
+            while True:
+                mtype, corr_id, payload = protocol.recv_frame(sock)
+                if mtype == protocol.T_RESP and corr_id == 1:
+                    return payload
+                if mtype == protocol.T_ERROR and corr_id == 1:
+                    return None
+    except (OSError, ValueError, ConnectionError):
+        return None
+
+
+class _RawClient:
+    """Sequential request/response client for the pull loop — one
+    in-flight call at a time, no reconnect magic (the manager owns
+    failure handling and redials)."""
+
+    def __init__(self, url: str, timeout: float = 10.0):
+        host, port = protocol.parse_bus_url(url)
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.timeout = timeout
+        self._req_id = 0
+
+    def call(self, payload: dict, timeout: Optional[float] = None) -> dict:
+        self._req_id += 1
+        self.sock.settimeout(timeout if timeout is not None else self.timeout)
+        protocol.send_frame(self.sock, protocol.T_REQ, self._req_id, payload)
+        while True:
+            mtype, corr_id, resp = protocol.recv_frame(self.sock)
+            if corr_id != self._req_id:
+                continue  # stray push frame (bookmark etc.) — not ours
+            if mtype == protocol.T_RESP:
+                return resp
+            if mtype == protocol.T_ERROR:
+                protocol.raise_error(resp)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class ReplicaManager:
+    """Role state machine for one apiserver replica.
+
+    Owns the election loop, the follower pull/apply loop, and the
+    leader-side coordinator; the BusServer consults ``is_leader`` to
+    route writes and delegates the ``repl_*``/proxy ops here."""
+
+    def __init__(
+        self,
+        store: PersistentAPIServer,
+        endpoints: List[str],
+        index: int,
+        lease_ttl: float = 2.0,
+        identity: Optional[str] = None,
+        on_became_leader=None,
+    ):
+        if not (0 <= index < len(endpoints)):
+            raise ValueError(
+                f"replica index {index} outside endpoint list "
+                f"({len(endpoints)} entries)"
+            )
+        self.store = store
+        self.endpoints = list(endpoints)
+        self.index = index
+        self.lease_ttl = lease_ttl
+        self.identity = identity or f"apiserver-{index}"
+        self.replica_count = len(endpoints)
+        self.on_became_leader = on_became_leader
+
+        self._lock = threading.Lock()
+        self.role = "init"  # guarded-by: self._lock
+        self.leader_url: Optional[str] = None  # guarded-by: self._lock
+        self.coordinator: Optional[ReplicationCoordinator] = None  # guarded-by: self._lock
+        self._proxy_client = None  # guarded-by: self._lock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        metrics.update_repl_role("init")
+
+    # ---- public surface ----
+
+    @property
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.role == "leader"
+
+    def start(self) -> "ReplicaManager":
+        self._thread = threading.Thread(
+            target=self._run, name=f"vtpu-repl-{self.identity}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            client = self._proxy_client
+            self._proxy_client = None
+            coord = self.coordinator
+        if coord is not None:
+            coord.shutdown()  # release writers parked on the quorum
+        if client is not None:
+            client.close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def proxy(self, payload: dict) -> dict:
+        """Forward a write op from this follower to the leader; the
+        response payload is relayed verbatim.  The ``proxied`` marker
+        caps forwarding at one hop — a stale leader view answers with a
+        typed error instead of bouncing the frame around the group."""
+        with self._lock:
+            client = self._proxy_client
+            leader = self.leader_url
+            role = self.role
+        if client is None or leader is None:
+            raise ApiError(
+                "no leader elected — write cannot be routed "
+                f"(replica {self.identity} is {role})"
+            )
+        if not client.wait_ready(0.0):
+            # the leader link is down (death/election in progress):
+            # FAIL FAST instead of parking the caller for the client's
+            # full reconnect timeout — the caller's retry lands after
+            # promotion replaces this proxy (loadgen's failover drill
+            # caught the parked variant blowing the submit budget)
+            raise ApiError(
+                f"leader {leader} unreachable from {self.identity} — "
+                "retry after the election settles"
+            )
+        fwd = dict(payload)
+        fwd["proxied"] = True
+        # bounded by the election timescale, not the generic client
+        # timeout: a wedged leader should surface to the caller fast
+        return client._call(  # noqa: SLF001 — same-package passthrough
+            fwd, timeout=min(max(self.lease_ttl * 4, 2.0), 15.0)
+        )
+
+    def status(self) -> dict:
+        """Replication fields merged into ``bus_status`` payloads."""
+        with self._lock:
+            out = {
+                "role": self.role,
+                "identity": self.identity,
+                "index": self.index,
+                "replicas": self.replica_count,
+                "endpoints": list(self.endpoints),
+                # a leader IS the group's leader — report its own
+                # endpoint, not the (None) url it follows
+                "leader": (
+                    self.endpoints[self.index] if self.role == "leader"
+                    else self.leader_url
+                ),
+                "quorum": quorum_of(self.replica_count),
+            }
+            coord = self.coordinator
+        if coord is not None:
+            out["followers"] = coord.follower_lags()
+            out["commit_seq"] = coord.commit_seq()
+        return out
+
+    # ---- leader-side op handlers (BusServer delegates here) ----
+
+    def _coordinator_or_raise(self) -> ReplicationCoordinator:
+        with self._lock:
+            coord = self.coordinator
+            if coord is None or self.role != "leader":
+                raise ApiError(f"not leader ({self.role})")
+            return coord
+
+    def handle_append(self, payload: dict) -> dict:
+        coord = self._coordinator_or_raise()
+        resp = coord.pull(
+            str(payload.get("id", "")),
+            int(payload.get("after", 0)),
+            int(payload.get("chain", 0)),
+            float(payload.get("wait_s", 0.0)),
+            int(payload.get("max", _PULL_MAX)),
+        )
+        resp["term"] = self.store.term
+        resp["epoch"] = self.store.epoch
+        return resp
+
+    def handle_snapshot(self, payload: dict) -> dict:
+        coord = self._coordinator_or_raise()
+        snap = self.store.dump_snapshot()
+        return {"snapshot": snap, "commit_seq": coord.commit_seq()}
+
+    def handle_commit(self, payload: dict) -> dict:
+        coord = self._coordinator_or_raise()
+        commit = coord.ack(
+            str(payload.get("id", "")), int(payload.get("applied", 0))
+        )
+        return {"commit_seq": commit, "leader_seq": self.store.event_seq}
+
+    # ---- the role loop ----
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                role = self.role
+            try:
+                if role == "leader":
+                    self._lead_tick()
+                    self._stop.wait(self.lease_ttl / 2)
+                else:
+                    self._follow()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                log.error("replica %s loop error: %s", self.identity, e)
+                self._stop.wait(0.2)
+
+    def _lead_tick(self) -> None:
+        """Leader heartbeat: watch for a competing leader.  A higher
+        term always wins (a deposed incarnation rejoining must step
+        down, not split the brain).  An EQUAL term — two candidates
+        that raced the same election — resolves by COMMIT seq first,
+        index second: with three replicas only one same-term leader can
+        hold a commit quorum, so the higher-commit leader is the one
+        whose acknowledgements a majority actually stores — deposing it
+        by mere index would erase majority-committed writes (the
+        rolling-kill soak caught exactly that).  The loser's own writes
+        are stalled-unacked (its quorum is gone), so ITS stepdown is
+        loss-free."""
+        with self._lock:
+            coord = self.coordinator
+        my_commit = coord.commit_seq() if coord is not None else 0
+        mine = (self.store.term, my_commit, -self.index)
+        for i, url in enumerate(self.endpoints):
+            if i == self.index:
+                continue
+            st = probe_status(url)
+            if st is None or st.get("role") != "leader":
+                continue
+            peer = (
+                int(st.get("term", 0)),
+                int(st.get("commit_seq", 0)),
+                -int(st.get("index", len(self.endpoints))),
+            )
+            if peer > mine:
+                log.error(
+                    "replica %s: peer %s leads at (term,commit)=%s over "
+                    "ours %s — stepping down",
+                    self.identity, url, peer[:2], mine[:2],
+                )
+                self._become_follower(url)
+                return
+        with self._lock:
+            coord = self.coordinator
+        metrics.update_repl_lag(
+            coord.max_lag_entries() if coord is not None else 0
+        )
+
+    def _become_follower(self, leader_url: Optional[str]) -> None:
+        self.store.set_replication(None, read_only=True)
+        with self._lock:
+            self.role = "follower"
+            coord = self.coordinator
+            self.coordinator = None
+            self._set_leader_locked(leader_url)
+        if coord is not None:
+            coord.shutdown()  # a deposed leader's parked writers abort
+        metrics.update_repl_role("follower")
+
+    def _set_leader_locked(self, leader_url: Optional[str]) -> None:
+        # requires-lock: self._lock
+        if leader_url == self.leader_url and self._proxy_client is not None:
+            return
+        old = self._proxy_client
+        self._proxy_client = None
+        self.leader_url = leader_url
+        if old is not None:
+            old.close()
+        if leader_url is not None:
+            from volcano_tpu.bus.remote import RemoteAPIServer
+
+            self._proxy_client = RemoteAPIServer(leader_url, timeout=15.0)
+
+    def _promote(self, term: int) -> None:
+        self.store.set_term(term)
+        coord = ReplicationCoordinator(
+            self.replica_count, self.identity,
+            base_seq=self.store.event_seq, base_chain=self.store.chain,
+        )
+        coord.start_flusher(self.store.flush_committed)
+        # order matters: the store must see the coordinator before the
+        # role flips to leader (the instant ``is_leader`` goes true the
+        # BusServer routes writes locally, and an un-replicated write
+        # acked without quorum would be exactly the loss this exists to
+        # prevent); the store-lock-atomic install also serializes the
+        # transition against in-flight transactions
+        self.store.set_replication(coord, read_only=False)
+        with self._lock:
+            self.coordinator = coord
+            self.role = "leader"
+            self._set_leader_locked(None)
+        metrics.update_repl_role("leader")
+        log.info("replica %s promoted to leader (term %d, seq %d)",
+                 self.identity, term, self.store.event_seq)
+        if self.on_became_leader is not None:
+            threading.Thread(
+                target=self.on_became_leader,
+                name=f"vtpu-repl-onlead-{self.identity}", daemon=True,
+            ).start()
+
+    def _elect(self) -> Optional[str]:
+        """Probe the group; return the leader url to follow, or None
+        after promoting ourselves.  Promotion requires a reachable
+        majority and being the most advanced — ``(term, seq, -index)``
+        — among it."""
+        statuses: Dict[str, dict] = {}
+        for i, url in enumerate(self.endpoints):
+            if i == self.index:
+                continue
+            st = probe_status(url)
+            if st is not None:
+                statuses[url] = st
+        # an existing leader wins immediately (highest (term, commit)
+        # first, lowest index on ties — _lead_tick's exact tie-break,
+        # so a racing dual-leadership resolves to the same winner from
+        # every observer's seat)
+        leaders = [
+            (int(st.get("term", 0)), int(st.get("commit_seq", 0)),
+             -int(st.get("index", len(self.endpoints))), url)
+            for url, st in statuses.items() if st.get("role") == "leader"
+        ]
+        if leaders:
+            leaders.sort(reverse=True)
+            return leaders[0][3]
+        reachable = len(statuses) + 1  # + self
+        if reachable < quorum_of(self.replica_count):
+            log.warning(
+                "replica %s: only %d/%d replicas reachable — refusing "
+                "promotion below quorum", self.identity, reachable,
+                self.replica_count,
+            )
+            return None
+        mine = (self.store.term, self.store.event_seq, -self.index)
+        best_peer = max(
+            (
+                (int(st.get("term", 0)), int(st.get("seq", 0)),
+                 -int(st.get("index", len(self.endpoints))))
+                for st in statuses.values()
+            ),
+            default=None,
+        )
+        if best_peer is None or mine >= best_peer:
+            if self.index > 0:
+                # deterministic stagger: tied candidates promote
+                # lowest-index first.  A probe snapshot can miss a peer
+                # mid-promotion (two candidates racing the same
+                # election), so the better-ranked replica gets a head
+                # start proportional to rank, and we re-check for a
+                # winner before claiming the term ourselves.
+                self._stop.wait(min(self.lease_ttl * 0.25, 0.3) * self.index)
+                if self._stop.is_set():
+                    return None
+                for i, url in enumerate(self.endpoints):
+                    if i == self.index:
+                        continue
+                    st = probe_status(url)
+                    if st is not None and st.get("role") == "leader":
+                        return url
+            max_term = max(
+                [self.store.term]
+                + [int(st.get("term", 0)) for st in statuses.values()]
+            )
+            self._promote(max_term + 1)
+            return None
+        return None  # a more advanced peer exists; let it promote
+
+    def _follow(self) -> None:
+        """One follower episode: find the leader, attach, pull until
+        the stream breaks, then re-elect.  Leader death is detected by
+        pull failure persisting past the lease TTL."""
+        self.store.set_replication(None, read_only=True)
+        metrics.update_repl_role("follower")
+        leader = self._elect()
+        if leader is None:
+            if self.is_leader:
+                return
+            self._stop.wait(min(0.2, self.lease_ttl / 4))
+            return
+        self._become_follower(leader)
+        raw: Optional[_RawClient] = None
+        failing_since: Optional[float] = None
+        try:
+            raw = _RawClient(leader, timeout=max(10.0, self.lease_ttl * 3))
+            while not self._stop.is_set():
+                # every leader interaction shares the same failure
+                # budget: transient blips redial inside the TTL window,
+                # persistent failure past the TTL declares the leader
+                # dead and re-elects.  (An early build let a failed
+                # repl_commit crash the episode straight into an
+                # election — a slow-but-alive leader then got deposed
+                # by its own followers under load.)
+                try:
+                    resp = raw.call({
+                        "op": "repl_append", "id": self.identity,
+                        "after": self.store.event_seq,
+                        "chain": self.store.chain,
+                        "wait_s": self.lease_ttl / 2, "max": _PULL_MAX,
+                    })
+                    if resp.get("snapshot_needed"):
+                        snap = raw.call(
+                            {"op": "repl_snapshot"},
+                            timeout=max(30.0, self.lease_ttl * 10),
+                        )["snapshot"]
+                        self.store.adopt_epoch(snap.get("epoch", ""))
+                        self.store.install_snapshot(snap)
+                        metrics.register_bus_recovery("snapshot")
+                        failing_since = None
+                        continue
+                    records = resp.get("records", ())
+                    commit = int(resp.get("commit_seq", 0))
+                    if records:
+                        self._apply_records(records)
+                        ack = raw.call({
+                            "op": "repl_commit", "id": self.identity,
+                            "applied": self.store.event_seq,
+                        })
+                        commit = max(commit, int(ack.get("commit_seq", 0)))
+                    failing_since = None
+                except (BusError, ApiError, OSError, ConnectionError) as e:
+                    now = time.monotonic()
+                    if failing_since is None:
+                        failing_since = now
+                    if now - failing_since >= self.lease_ttl:
+                        log.error(
+                            "replica %s: leader %s unreachable past the "
+                            "lease TTL (%s) — re-electing",
+                            self.identity, leader, e,
+                        )
+                        return
+                    # redial inside the TTL window (transient blip)
+                    try:
+                        raw.close()
+                        raw = _RawClient(
+                            leader, timeout=max(10.0, self.lease_ttl * 3)
+                        )
+                    except OSError:
+                        self._stop.wait(min(0.1, self.lease_ttl / 8))
+                    continue
+                self.store.adopt_epoch(resp.get("epoch", ""))
+                if int(resp.get("term", 0)) > self.store.term:
+                    self.store.set_term(int(resp["term"]))
+                self.store.flush_committed(commit)
+                metrics.update_repl_lag(
+                    max(0, int(resp.get("leader_seq", 0))
+                        - self.store.event_seq)
+                )
+        finally:
+            if raw is not None:
+                raw.close()
+
+    def _apply_records(self, records) -> None:
+        from volcano_tpu import faults
+
+        fp = faults.get_plane()
+        last = len(records) - 1
+        for i, rec in enumerate(records):
+            if fp.enabled and fp.should("repl.lag"):
+                time.sleep(fp.param_ms("repl.lag") / 1e3)
+            # one fsync per shipped batch, not per record — the leader
+            # already holds every record durable, so batch-tail fsync
+            # loses nothing a leader failure wouldn't re-ship
+            self.store.apply_replica_record(
+                rec["payload"].encode(), sync=(i == last)
+            )

@@ -262,6 +262,7 @@ class BusServer:
         backlog_size: int = 4096,
         bookmark_interval: float = 2.0,
         admission_timeout: float = 10.0,
+        replica=None,
     ):
         self.api = api
         self.host = host
@@ -269,10 +270,21 @@ class BusServer:
         self.backlog_size = backlog_size
         self.bookmark_interval = bookmark_interval
         self.admission_timeout = admission_timeout
-        #: epoch: identifies THIS server incarnation.  A resume token
-        #: from another incarnation can never be judged against our
-        #: sequence numbers, so it is answered with relist-required.
-        self.epoch = uuid.uuid4().hex
+        #: replication role manager (bus/replication.py): routes write
+        #: ops to the leader while this replica follows, and serves the
+        #: repl_* log-shipping ops while it leads.  None = standalone.
+        self.replica = replica
+        #: epoch: identifies the resume-token space.  A volatile store
+        #: mints a fresh one per incarnation (a resume token from
+        #: another incarnation can never be judged against our sequence
+        #: numbers → relist-required); a persistent store carries its
+        #: epoch in the data-dir meta — shared across restarts AND
+        #: across replicas — so surviving cursors resume instead.
+        self._own_epoch = uuid.uuid4().hex
+        #: durable stores restore the sequence + backlog at start();
+        #: afterwards the central watchers keep _seq in lockstep with
+        #: the store's committed event stream (see _make_central_watcher)
+        self._persistent = hasattr(api, "current_event_seq")
         self._seq = 0  # guarded-by: self.api.locked()
         #: retained watch entries (cached-payload wrappers, shared with
         #: every subscriber queue)
@@ -295,6 +307,10 @@ class BusServer:
         self._stop = threading.Event()
 
     # ---- lifecycle ----
+
+    @property
+    def epoch(self) -> str:
+        return getattr(self.api, "epoch", "") or self._own_epoch
 
     @property
     def port(self) -> int:
@@ -320,6 +336,16 @@ class BusServer:
                     raise
                 time.sleep(0.05)
         self._listener.listen(64)
+        if self._persistent:
+            # recovery restores the resume surface: the store's durable
+            # event seq and recent-event ring become this incarnation's
+            # sequence + backlog, so clients whose cursor survived the
+            # restart resume instead of relisting (the 410-storm fix)
+            with self.api.locked():
+                self._seq = self.api.event_seq
+                self._backlog = [
+                    _CachedPayload(e) for e in self.api.recent_events()
+                ][-self.backlog_size:]
         for kind in protocol.KINDS:
             handler = self._make_central_watcher(kind)
             self.api.watch(kind, handler, send_initial=False)
@@ -367,7 +393,14 @@ class BusServer:
             # requires-lock: self.api.locked()
             # (store watchers fire under the store lock — the
             # _notify discipline documented on APIServer.locked)
-            self._seq += 1
+            if self._persistent:
+                # lockstep with the durable stream: the persistent
+                # store stamps each committed event's seq just before
+                # flushing its notification (wal.py), so bus sequence
+                # numbers survive restarts and match across replicas
+                self._seq = self.api.current_event_seq
+            else:
+                self._seq += 1
             entry = _CachedPayload({
                 "seq": self._seq,
                 "kind": kind,
@@ -538,8 +571,49 @@ class BusServer:
             conn.push(protocol.T_ERROR, req_id, protocol.error_payload(ApiError(str(e))))
             metrics.observe_bus_server_request(op, time.perf_counter() - start, "error")
 
+    #: ops that mutate (or linearizably read) the store — while this
+    #: server is a replication FOLLOWER they are proxied to the leader,
+    #: so a client connected anywhere keeps working; watches and lists
+    #: stay local (informer-grade staleness, the k8s contract).  ``get``
+    #: is routed too: read-modify-CAS loops (leader leases, queue
+    #: updates) need their read against the write point or every CAS
+    #: would spuriously conflict on follower lag.
+    _LEADER_OPS = frozenset({
+        "create", "update", "update_status", "delete",
+        "cas_bind", "commit_batch", "get",
+    })
+
     def _execute(self, conn: _Conn, req_id: int, payload: dict, op: str):
         api = self.api
+        replica = self.replica
+        if replica is not None and not replica.is_leader:
+            if op in self._LEADER_OPS:
+                if payload.get("proxied"):
+                    # one-hop cap: our leader view is stale — tell the
+                    # proxying peer instead of bouncing frames around
+                    raise ApiError("not leader (proxied write refused)")
+                return replica.proxy(payload)
+            if op == "register_admission":
+                raise ApiError(
+                    "not leader — register_admission must run at the "
+                    f"leader ({replica.leader_url or 'unknown'})"
+                )
+        if op == "bus_status":
+            from volcano_tpu.bus.wal import bus_status_payload
+
+            return bus_status_payload(api, replica)
+        if op == "repl_append":
+            if replica is None:
+                raise ApiError("replication not enabled")
+            return replica.handle_append(payload)
+        if op == "repl_snapshot":
+            if replica is None:
+                raise ApiError("replication not enabled")
+            return replica.handle_snapshot(payload)
+        if op == "repl_commit":
+            if replica is None:
+                raise ApiError("replication not enabled")
+            return replica.handle_commit(payload)
         if op == "create":
             obj = protocol.decode_obj(payload["object"])
             obj = self._remote_admission(obj.kind, "CREATE", obj)

@@ -447,6 +447,62 @@ def _shards(vc: VolcanoClient, args, out) -> int:
     return 0
 
 
+# ---- bus (the replicated persistent bus observability surface) ----
+
+def _bus_status(vc: VolcanoClient, args, out) -> int:
+    """Render the bus durability/replication status: role, leader
+    identity, term/epoch, applied + committed sequence, WAL/snapshot
+    sizes and fsync stats, and per-follower replication lag (entries +
+    ms).  Reads ONLY the ``bus_status`` payload (stored/derived state,
+    no call-time clocks), so the output is byte-identical over the
+    in-process backend and ``--bus`` for the same store state — the
+    ``vtctl shards`` discipline."""
+    api = vc.api
+    st = api.bus_status() if hasattr(api, "bus_status") else {
+        "role": "standalone", "persistent": False,
+    }
+    print(f"Role:               {st.get('role', 'unknown')}", file=out)
+    if st.get("identity"):
+        print(f"Identity:           {st['identity']} "
+              f"(index {st.get('index', '?')} of "
+              f"{st.get('replicas', '?')})", file=out)
+    if "leader" in st:
+        print(f"Leader:             {st.get('leader') or '<none elected>'}",
+              file=out)
+    print(f"Persistent:         {str(bool(st.get('persistent'))).lower()}",
+          file=out)
+    if not st.get("persistent"):
+        return 0
+    print(f"Epoch:              {st.get('epoch', '')}", file=out)
+    print(f"Term:               {st.get('term', 0)}", file=out)
+    print(f"Applied seq:        {st.get('seq', 0)}", file=out)
+    if "commit_seq" in st:
+        print(f"Committed seq:      {st['commit_seq']}", file=out)
+    if "quorum" in st:
+        print(f"Quorum:             {st['quorum']} of "
+              f"{st.get('replicas', 1)}", file=out)
+    print(f"WAL:                {st.get('wal_size_bytes', 0)} bytes, "
+          f"{st.get('wal_records', 0)} records since snapshot", file=out)
+    print(f"Snapshot:           {st.get('snapshot_size_bytes', 0)} bytes "
+          f"@ seq {st.get('snapshot_seq', 0)}", file=out)
+    print(f"Last fsync:         {st.get('last_fsync_ms', 0)} ms "
+          f"at {st.get('last_fsync_ts', 0)}", file=out)
+    followers = st.get("followers", {})
+    if followers:
+        print("Followers:", file=out)
+        print(f"  {'ID':<22}{'ACKED':<9}{'LAG':<7}{'LAG-MS':<9}", file=out)
+        for fid in sorted(followers):
+            f = followers[fid]
+            print(
+                f"  {fid:<22}{f.get('acked_seq', 0):<9}"
+                f"{f.get('lag_entries', 0):<7}{f.get('lag_ms', 0):<9g}",
+                file=out,
+            )
+    elif st.get("role") == "leader" and int(st.get("replicas", 1)) > 1:
+        print("Followers:          <none attached>", file=out)
+    return 0
+
+
 # ---- trace subcommands (volcano_tpu/trace) ----
 
 def _faults_validate(vc: VolcanoClient, args, out) -> int:
@@ -649,6 +705,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     shards.set_defaults(cmd=None)
 
+    bus_p = sub.add_parser(
+        "bus",
+        description="replicated persistent bus (WAL + leader/follower "
+        "apiserver HA)",
+    ).add_subparsers(dest="cmd", required=True)
+    bus_p.add_parser(
+        "status",
+        description="role, leader identity, term, WAL/snapshot sizes, "
+        "fsync stats, per-follower replication lag",
+    )
+
     trace_p = sub.add_parser(
         "trace", description="cycle journal: record, replay, diff, export"
     ).add_subparsers(dest="cmd", required=True)
@@ -725,6 +792,7 @@ _HANDLERS = {
     ("describe", "job"): _describe_job,
     ("describe", "podgroup"): _describe_podgroup,
     ("shards", None): _shards,
+    ("bus", "status"): _bus_status,
     ("faults", "validate"): _faults_validate,
     ("trace", "record"): _trace_record,
     ("trace", "replay"): _trace_replay,

@@ -99,6 +99,15 @@ class APIServer:
             obj = hook(operation, obj) or obj
         return obj
 
+    def bus_status(self) -> dict:
+        """Durability/replication status surface (``vtctl bus status``).
+        The plain in-process store is neither persistent nor
+        replicated; ``bus.PersistentAPIServer`` overrides this with the
+        WAL/snapshot/replication fields, and ``bus.RemoteAPIServer``
+        fetches the same payload over the wire — one renderer, every
+        backend."""
+        return {"role": "standalone", "persistent": False}
+
     # ---- admission registration (the webhook configuration) ----
 
     def register_admission(self, kind: str, operation: str, hook: AdmissionHook) -> None:

@@ -11,13 +11,28 @@ vtpu-controllers, vtpu-admission, vtctl — connects with
 ``--bus tcp://host:port`` and the system runs as the reference's
 multi-process deployment topology, including cross-process leader
 election (the scheduler's ConfigMap lease lives on this store).
+
+Durability + HA (ROADMAP item 4):
+
+* ``--data-dir DIR`` swaps the volatile store for
+  ``bus.PersistentAPIServer`` — every store transaction is WAL'd and
+  fsynced before acking, snapshots rotate the log, and a restart with
+  the same dir resumes watch cursors instead of forcing a 410 relist
+  storm.
+* ``--replicas tcp://a,tcp://b,... --replica-index I`` joins this
+  daemon to a replication group (requires ``--data-dir``): one leader
+  takes writes, followers replicate its WAL and serve reads/watches,
+  and a SIGKILLed leader is replaced by the most-advanced survivor
+  within one lease TTL (``--repl-lease-ttl``).  Point clients at the
+  whole list: ``--bus tcp://a,tcp://b,...``.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import threading
-from typing import Optional
+from typing import List, Optional
 
 from volcano_tpu.bus.server import BusServer
 from volcano_tpu.client.apiserver import APIServer
@@ -30,7 +45,8 @@ DEFAULT_BUS_PORT = 7180
 
 
 class ApiServerDaemon:
-    """The apiserver binary: store + bus listener + serving surface."""
+    """The apiserver binary: store + bus listener + serving surface,
+    optionally durable (``data_dir``) and replicated (``replicas``)."""
 
     def __init__(
         self,
@@ -44,11 +60,46 @@ class ApiServerDaemon:
         seed_nodes: int = 0,
         seed_node_cpu: str = "8",
         seed_node_mem: str = "32Gi",
+        data_dir: str = "",
+        snapshot_every: int = 256,
+        replicas: Optional[List[str]] = None,
+        replica_index: int = 0,
+        repl_lease_ttl: float = 2.0,
     ):
-        self.api = api if api is not None else APIServer()
+        self.replica = None
+        if api is not None:
+            self.api = api
+        elif data_dir:
+            from volcano_tpu.bus.wal import PersistentAPIServer
+
+            self.api = PersistentAPIServer(
+                data_dir, snapshot_every=snapshot_every,
+                backlog_keep=backlog_size,
+            )
+            # the SIGKILL-mid-commit chaos point (bus.leader_kill):
+            # crash-stop exactly like the federation's shard.kill
+            self.api.kill_hook = lambda: os._exit(137)
+        else:
+            self.api = APIServer()
+        if replicas and len(replicas) > 1:
+            from volcano_tpu.bus.wal import PersistentAPIServer
+
+            if not isinstance(self.api, PersistentAPIServer):
+                raise ValueError(
+                    "--replicas requires --data-dir (replication ships "
+                    "WAL records; a volatile store has none)"
+                )
+            from volcano_tpu.bus.replication import ReplicaManager
+
+            self.replica = ReplicaManager(
+                self.api, replicas, replica_index,
+                lease_ttl=repl_lease_ttl,
+                on_became_leader=self._seed_if_configured,
+            )
         self.bus = BusServer(
             self.api, host=listen_host, port=bus_port,
             backlog_size=backlog_size, bookmark_interval=bookmark_interval,
+            replica=self.replica,
         )
         self.serving = ServingServer(
             host=listen_host, port=listen_port,
@@ -58,28 +109,67 @@ class ApiServerDaemon:
         #: synthetic node pool + default queue on startup (idempotent).
         #: A real cluster's nodes arrive from kubelets; the standalone
         #: build's arrive from whoever owns the store — this daemon in
-        #: the multi-process topology, vtpu-local-up otherwise.
+        #: the multi-process topology, vtpu-local-up otherwise.  In a
+        #: replication group only the LEADER may write, so seeding runs
+        #: from the became-leader hook instead of start().
         self.seed_nodes = seed_nodes
         self.seed_node_cpu = seed_node_cpu
         self.seed_node_mem = seed_node_mem
 
-    def start(self) -> "ApiServerDaemon":
-        if self.seed_nodes > 0:
-            from volcano_tpu.cmd.local_up import seed_cluster
+    def _seed_if_configured(self) -> None:
+        if self.seed_nodes <= 0:
+            return
+        import time
 
-            seed_cluster(self.api, self.seed_nodes,
-                         self.seed_node_cpu, self.seed_node_mem)
+        from volcano_tpu.client.apiserver import ApiError
+        from volcano_tpu.cmd.local_up import seed_cluster
+
+        # quorum forms as followers attach; retry until the writes land
+        # (idempotent — AlreadyExists is a no-op in seed_cluster).  The
+        # loop never gives up silently: an unseeded cluster idles with
+        # every job unschedulable and nothing pointing at the cause —
+        # keep retrying (daemon thread, dies with the process) and get
+        # LOUD about persistent failure.  If leadership moved on, the
+        # new leader owns seeding and this attempt stands down.
+        attempt = 0
+        while True:
+            if self.replica is not None and not self.replica.is_leader:
+                log.info("seed attempt stands down: no longer the leader")
+                return
+            try:
+                seed_cluster(self.api, self.seed_nodes,
+                             self.seed_node_cpu, self.seed_node_mem)
+                return
+            except ApiError as e:
+                attempt += 1
+                level = log.error if attempt % 10 == 0 else log.warning
+                level("cluster seeding failing (attempt %d): %s",
+                      attempt, e)
+                time.sleep(min(0.5 * attempt, 5.0))
+
+    def start(self) -> "ApiServerDaemon":
+        if self.seed_nodes > 0 and self.replica is None:
+            self._seed_if_configured()
         self.bus.start()
         self.serving.start()
+        if self.replica is not None:
+            self.replica.start()
         log.info(
-            "apiserver up: bus on :%d, metrics on :%d",
+            "apiserver up: bus on :%d, metrics on :%d%s",
             self.bus.port, self.serving.port,
+            (f", replica {self.replica.identity} of "
+             f"{self.replica.replica_count}") if self.replica else "",
         )
         return self
 
     def stop(self) -> None:
+        if self.replica is not None:
+            self.replica.stop()
         self.bus.stop()
         self.serving.stop()
+        close = getattr(self.api, "close", None)
+        if close is not None:
+            close()
 
 
 def main(argv=None) -> int:
@@ -102,20 +192,48 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--seed-nodes", type=int, default=0,
         help="create a synthetic node pool + default queue on startup "
-        "(the standalone cluster's kubelet substitute; 0 = off)",
+        "(the standalone cluster's kubelet substitute; 0 = off; in a "
+        "replication group the leader seeds after election)",
     )
     parser.add_argument("--seed-node-cpu", default="8")
     parser.add_argument("--seed-node-mem", default="32Gi")
     parser.add_argument(
+        "--data-dir", default="",
+        help="WAL + snapshot directory: store transactions are fsynced "
+        "before acking and a restart resumes watch cursors (empty = "
+        "volatile in-memory store, the pre-HA behavior)",
+    )
+    parser.add_argument(
+        "--snapshot-every", type=int, default=256,
+        help="rotate the WAL into a full snapshot every N records",
+    )
+    parser.add_argument(
+        "--replicas", default="",
+        help="comma-separated endpoint list of the WHOLE replication "
+        "group (this replica included), e.g. tcp://a:7180,tcp://b:7180; "
+        "requires --data-dir",
+    )
+    parser.add_argument(
+        "--replica-index", type=int, default=0,
+        help="this daemon's position in the --replicas list",
+    )
+    parser.add_argument(
+        "--repl-lease-ttl", type=float, default=2.0,
+        help="leader-liveness lease: a follower that cannot reach the "
+        "leader for this long triggers an election",
+    )
+    parser.add_argument(
         "--faults", default="",
-        help="deterministic fault-injection schedule (bus.* points fire "
-        "server-side here; same grammar as VTPU_FAULTS)",
+        help="deterministic fault-injection schedule (bus.* / wal.* / "
+        "repl.* points fire server-side here; same grammar as "
+        "VTPU_FAULTS)",
     )
     args = parser.parse_args(argv)
     from volcano_tpu.cmd.daemon import apply_faults
 
     apply_faults(args.faults)
 
+    replicas = [u.strip() for u in args.replicas.split(",") if u.strip()]
     daemon = ApiServerDaemon(
         listen_host=args.listen_host,
         bus_port=args.port,
@@ -126,6 +244,11 @@ def main(argv=None) -> int:
         seed_nodes=args.seed_nodes,
         seed_node_cpu=args.seed_node_cpu,
         seed_node_mem=args.seed_node_mem,
+        data_dir=args.data_dir,
+        snapshot_every=args.snapshot_every,
+        replicas=replicas,
+        replica_index=args.replica_index,
+        repl_lease_ttl=args.repl_lease_ttl,
     ).start()
     try:
         threading.Event().wait()

@@ -154,25 +154,60 @@ def multiproc_up(nodes: int = 3, node_cpu: str = "8", node_mem: str = "16Gi",
                  standby_scheduler: bool = False,
                  schedule_period: float = 0.2,
                  micro_cycles: bool = False,
+                 apiserver_replicas: int = 1,
+                 apiserver_data_dir: str = "",
+                 repl_lease_ttl: float = 2.0,
                  ) -> Tuple[object, List[subprocess.Popen]]:
     """The reference's deployment topology as real OS processes:
     vtpu-apiserver + vtpu-admission + vtpu-controllers + vtpu-scheduler
     (two schedulers with leader election when ``standby_scheduler``).
+    ``apiserver_replicas > 1`` spawns the replicated persistent bus —
+    N ``vtpu-apiserver`` processes with per-replica WAL dirs forming a
+    leader/follower group, every daemon dialed to the full endpoint
+    list.
 
     Returns ``(RemoteAPIServer, [Popen, ...])``; the caller owns
     process teardown (``shutdown_procs``)."""
+    import tempfile
+
     from volcano_tpu.bus import connect_bus
 
     if bus_port == 0:
         bus_port = _free_port(listen_host)
-    bus_url = f"tcp://{listen_host}:{bus_port}"
     procs: List[subprocess.Popen] = []
 
-    procs.append(_spawn(
-        "volcano_tpu.cmd.apiserver",
-        "--listen-host", listen_host, "--port", str(bus_port),
-        "--listen-port", "0",
-    ))
+    if apiserver_replicas > 1:
+        ports = [bus_port] + [
+            _free_port(listen_host) for _ in range(apiserver_replicas - 1)
+        ]
+        endpoints = [f"tcp://{listen_host}:{p}" for p in ports]
+        bus_url = ",".join(endpoints)
+        base_dir = apiserver_data_dir or tempfile.mkdtemp(
+            prefix="vtpu-apiserver-"
+        )
+        for i, port in enumerate(ports):
+            procs.append(_spawn(
+                "volcano_tpu.cmd.apiserver",
+                "--listen-host", listen_host, "--port", str(port),
+                "--listen-port", "0",
+                "--data-dir", os.path.join(base_dir, f"replica-{i}"),
+                "--replicas", bus_url,
+                "--replica-index", str(i),
+                "--repl-lease-ttl", str(repl_lease_ttl),
+                # the LEADER seeds after election (followers are
+                # read-only), so every replica carries the flag
+                "--seed-nodes", str(nodes),
+                "--seed-node-cpu", node_cpu, "--seed-node-mem", node_mem,
+            ))
+    else:
+        bus_url = f"tcp://{listen_host}:{bus_port}"
+        apiserver_flags = [
+            "--listen-host", listen_host, "--port", str(bus_port),
+            "--listen-port", "0",
+        ]
+        if apiserver_data_dir:
+            apiserver_flags += ["--data-dir", apiserver_data_dir]
+        procs.append(_spawn("volcano_tpu.cmd.apiserver", *apiserver_flags))
     api = None
     try:
         # BusError after the wait means the spawned apiserver never came
@@ -203,7 +238,23 @@ def multiproc_up(nodes: int = 3, node_cpu: str = "8", node_mem: str = "16Gi",
                 flags += ["--leader-elect", "--leader-elect-id", f"sched-{i}"]
             procs.append(_spawn("volcano_tpu.cmd.scheduler", *flags))
 
-        seed_cluster(api, nodes, node_cpu, node_mem)
+        if apiserver_replicas > 1:
+            # the elected leader seeds (followers are read-only); wait
+            # for the pool to appear instead of racing the election
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                try:
+                    if len(api.list("Node")) >= nodes and api.list("Queue"):
+                        break
+                except Exception:  # noqa: BLE001 — group still electing
+                    pass
+                time.sleep(0.2)
+            else:
+                raise RuntimeError(
+                    "replicated apiserver group never seeded the cluster"
+                )
+        else:
+            seed_cluster(api, nodes, node_cpu, node_mem)
     except BaseException:
         # a failure mid-setup must not strand the daemons it already
         # spawned (the caller never gets a handle to clean them up)
@@ -285,6 +336,18 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--bus-port", type=int, default=0,
                         help="with --multiproc: fixed bus port "
                         "(0 = pick a free one)")
+    parser.add_argument("--apiserver-replicas", type=int, default=1,
+                        help="with --multiproc: spawn N vtpu-apiserver "
+                        "replicas forming the replicated persistent bus "
+                        "(WAL + leader/follower log shipping); daemons "
+                        "dial the full endpoint list")
+    parser.add_argument("--apiserver-data-dir", default="",
+                        help="with --multiproc: WAL/snapshot directory "
+                        "(per-replica subdirs when replicated; empty = "
+                        "a temp dir for replicas, volatile store for a "
+                        "single apiserver)")
+    parser.add_argument("--repl-lease-ttl", type=float, default=2.0,
+                        help="apiserver leader-liveness lease TTL")
     parser.add_argument("--listen-host", default="127.0.0.1")
     parser.add_argument("--scheduler-port", type=int, default=0)
     parser.add_argument("--controllers-port", type=int, default=0)
@@ -326,6 +389,9 @@ def main(argv=None) -> int:
             bus_port=args.bus_port,
             standby_scheduler=args.standby_scheduler,
             micro_cycles=args.micro_cycles,
+            apiserver_replicas=args.apiserver_replicas,
+            apiserver_data_dir=args.apiserver_data_dir,
+            repl_lease_ttl=args.repl_lease_ttl,
         )
         print(f"multi-process control plane up: bus {api.address}, "
               f"{len(procs)} daemons "

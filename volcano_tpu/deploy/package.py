@@ -54,6 +54,22 @@ DEFAULT_VALUES: Dict[str, Any] = {
     "apiserver": {
         "port": 8083,
         "backlog_size": 4096,
+        # WAL + snapshot directory (bus/wal.py): every store
+        # transaction is fsynced before acking and a restarted pod
+        # resumes watch cursors instead of forcing a cluster-wide 410
+        # relist.  Backed by an emptyDir by default — durable across
+        # container restarts on the same node; replication (below) is
+        # what covers node loss.  Point it at a PVC mount for
+        # single-replica node-loss durability.
+        "data_dir": "/var/lib/vtpu",
+        # replicated persistent bus: N > 1 renders one apiserver
+        # Deployment + Service PER REPLICA (stable per-replica DNS is
+        # the static membership list), wires every daemon's --bus to
+        # the full endpoint list, and the replicas elect a leader —
+        # writes quorum-commit, a SIGKILLed leader is replaced by the
+        # most-advanced survivor within one lease TTL.
+        "replicas": 1,
+        "repl_lease_ttl": 2.0,
     },
     "scheduler": {
         # synthetic node pool the apiserver seeds (kubelet substitute)
@@ -239,7 +255,19 @@ def render(values: Dict[str, Any]) -> List[Tuple[str, Dict[str, Any]]]:
     sched_port = int(values["scheduler"]["port"])
     ctrl_port = int(values["controllers"]["port"])
     adm_port = int(values["admission"]["port"])
-    bus_url = f"tcp://{name}-apiserver.{ns}.svc:{bus_port}"
+    api_replicas = int(values["apiserver"].get("replicas", 1) or 1)
+    data_dir = values["apiserver"].get("data_dir", "") or ""
+    if api_replicas > 1:
+        # per-replica Services are the static membership list: every
+        # daemon (and every replica) dials the same ordered endpoints
+        bus_urls = [
+            f"tcp://{name}-apiserver-{i}.{ns}.svc:{bus_port}"
+            for i in range(api_replicas)
+        ]
+        bus_url = ",".join(bus_urls)
+    else:
+        bus_urls = [f"tcp://{name}-apiserver.{ns}.svc:{bus_port}"]
+        bus_url = bus_urls[0]
 
     def scrape(port: int) -> Dict[str, str]:
         if not values["prometheus"]["scrape"]:
@@ -266,49 +294,82 @@ def render(values: Dict[str, Any]) -> List[Tuple[str, Dict[str, Any]]]:
     }))
 
     # ---- apiserver: the bus every other daemon dials ----
-    api_labels = {"app": f"{name}-apiserver"}
-    manifests.append(("20-apiserver-deployment.yaml", _deployment(
-        f"{name}-apiserver", ns, api_labels,
-        containers=[{
+    # One Deployment (+ Service) per replica: replicas need stable,
+    # individually-addressable endpoints (the static membership list),
+    # and each owns its own WAL volume.  A single replica keeps the
+    # original one-Deployment shape.
+    def apiserver_manifests(suffix: str, index: int):
+        deploy_name = f"{name}-apiserver{suffix}"
+        api_labels = {"app": deploy_name}
+        command = [
+            "vtpu-apiserver",
+            "--listen-host", "0.0.0.0",
+            "--port", str(bus_port),
+            "--listen-port", str(api_port),
+            "--backlog-size", str(int(values["apiserver"]["backlog_size"])),
+            "--seed-nodes", str(int(values["scheduler"]["nodes"])),
+        ]
+        volumes: List[Dict[str, Any]] = []
+        mounts: List[Dict[str, Any]] = []
+        if data_dir:
+            command += ["--data-dir", data_dir]
+            volumes.append({"name": "bus-data", "emptyDir": {}})
+            mounts.append({"name": "bus-data", "mountPath": data_dir})
+        if api_replicas > 1:
+            command += [
+                "--replicas", bus_url,
+                "--replica-index", str(index),
+                "--repl-lease-ttl",
+                str(values["apiserver"].get("repl_lease_ttl", 2.0)),
+            ]
+        container: Dict[str, Any] = {
             "name": "apiserver",
             "image": image,
-            "command": [
-                "vtpu-apiserver",
-                "--listen-host", "0.0.0.0",
-                "--port", str(bus_port),
-                "--listen-port", str(api_port),
-                "--backlog-size", str(int(values["apiserver"]["backlog_size"])),
-                "--seed-nodes", str(int(values["scheduler"]["nodes"])),
-            ],
+            "command": command,
             "livenessProbe": _probe(api_port),
             "ports": [
                 {"containerPort": bus_port, "name": "bus"},
                 {"containerPort": api_port, "name": "metrics"},
             ],
-        }],
-        volumes=[],
-        # one replica: the store itself is the consistency point (the
-        # reference's etcd-backed apiserver HA is out of scope); daemons
-        # reconnect-and-resync through its restarts
-        replicas=1,
-        annotations=scrape(api_port),
-        image_pull_secret=pull_secret,
-        strategy="Recreate",
-    )))
+        }
+        if mounts:
+            container["volumeMounts"] = mounts
+        deployment = _deployment(
+            deploy_name, ns, api_labels,
+            containers=[container],
+            volumes=volumes,
+            # one pod per Deployment either way: a replica IS the unit
+            # of replication (k8s surge copies would split the WAL),
+            # and the single-apiserver store is the consistency point
+            replicas=1,
+            annotations=scrape(api_port),
+            image_pull_secret=pull_secret,
+            strategy="Recreate",
+        )
+        service = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": deploy_name, "namespace": ns,
+                         "labels": api_labels},
+            "spec": {
+                "selector": api_labels,
+                "ports": [
+                    {"name": "bus", "port": bus_port},
+                    {"name": "metrics", "port": api_port},
+                ],
+            },
+        }
+        return deployment, service
 
-    manifests.append(("21-apiserver-service.yaml", {
-        "apiVersion": "v1",
-        "kind": "Service",
-        "metadata": {"name": f"{name}-apiserver", "namespace": ns,
-                     "labels": api_labels},
-        "spec": {
-            "selector": api_labels,
-            "ports": [
-                {"name": "bus", "port": bus_port},
-                {"name": "metrics", "port": api_port},
-            ],
-        },
-    }))
+    if api_replicas > 1:
+        for i in range(api_replicas):
+            dep, svc = apiserver_manifests(f"-{i}", i)
+            manifests.append((f"20-apiserver-{i}-deployment.yaml", dep))
+            manifests.append((f"21-apiserver-{i}-service.yaml", svc))
+    else:
+        dep, svc = apiserver_manifests("", 0)
+        manifests.append(("20-apiserver-deployment.yaml", dep))
+        manifests.append(("21-apiserver-service.yaml", svc))
 
     # ---- scheduler: leader-elected replicas + compute-plane sidecar,
     # or N shard-pinned federation members when scheduler.shards > 1 ----

@@ -284,6 +284,54 @@ def update_bus_watch_lag(seconds: float) -> None:
     ).observe(max(seconds, 0.0) * 1e3)
 
 
+# ---- replicated persistent bus (bus/wal.py + bus/replication.py) ----
+# The durability plane's vital signs: fsync cost (the floor under every
+# acked write), WAL growth between snapshots, replication lag, the
+# replica's current role, and how often recovery actually ran.
+
+def observe_wal_fsync(seconds: float) -> None:
+    """volcano_wal_fsync_latency_milliseconds: one WAL fsync — the
+    durability cost every acknowledged store transaction pays."""
+    registry.histogram(
+        f"{_NAMESPACE}_wal_fsync_latency_milliseconds", {}
+    ).observe(seconds * 1e3)
+
+
+def update_wal_size(size_bytes: int) -> None:
+    """volcano_wal_size_bytes: bytes in the live WAL segment (resets to
+    0 at each snapshot rotation — sawtooth growth is healthy, an
+    unbounded ramp means snapshots stopped)."""
+    registry.set_gauge(f"{_NAMESPACE}_wal_size_bytes", {}, size_bytes)
+
+
+def update_repl_lag(entries: int) -> None:
+    """volcano_repl_lag_entries: replication lag in log entries — on
+    the leader, the slowest follower's deficit; on a follower, its own
+    distance behind the leader's last shipped record."""
+    registry.set_gauge(f"{_NAMESPACE}_repl_lag_entries", {}, entries)
+
+
+#: bounded role vocabulary for the one-hot role gauge
+_REPL_ROLES = ("leader", "follower", "standalone", "init")
+
+
+def update_repl_role(role: str) -> None:
+    """volcano_repl_role{role}: one-hot role gauge (1 on the current
+    role's series, 0 on the rest) so a promotion flip is a visible
+    edge on both series."""
+    for r in _REPL_ROLES:
+        registry.set_gauge(
+            f"{_NAMESPACE}_repl_role", {"role": r}, 1.0 if r == role else 0.0
+        )
+
+
+def register_bus_recovery(kind: str) -> None:
+    """volcano_bus_recoveries_total{kind}: one count per recovery
+    source actually used at startup/resync — kind ∈ {snapshot,
+    wal_tail}."""
+    registry.inc(f"{_NAMESPACE}_bus_recoveries_total", {"kind": kind})
+
+
 def observe_bus_server_request(op: str, seconds: float, code: str) -> None:
     registry.inc(f"{_NAMESPACE}_bus_server_requests_total",
                  {"op": op, "code": code})
