@@ -98,8 +98,8 @@ class LoadgenTopology:
         self.vc.create_queue(_build_queue("default"))
         for i in range(n_nodes):
             self.kube.create_node(
-                _build_node(f"n{i:04d}", {"cpu": str(node_cpu),
-                                          "memory": "256Gi"})
+                _build_node(_node_name(i), {"cpu": str(node_cpu),
+                                            "memory": "256Gi"})
             )
 
         #: ns/name → wall-clock the bind landed at store truth
@@ -410,8 +410,8 @@ class ReplicatedBusTopology(LoadgenTopology):
                 time.sleep(0.25)
         for i in range(n_nodes):
             self.kube.create_node(
-                _build_node(f"n{i:04d}", {"cpu": str(node_cpu),
-                                          "memory": "256Gi"})
+                _build_node(_node_name(i), {"cpu": str(node_cpu),
+                                            "memory": "256Gi"})
             )
 
         self.bind_ts: Dict[str, float] = {}
@@ -535,6 +535,14 @@ class ReplicatedBusTopology(LoadgenTopology):
 
 # ---- builders (bench is standalone: no tests/ import) ----
 
+def _node_name(i: int) -> str:
+    """The topology's node naming — ONE copy, because `_gang_plan`
+    recomputes per-shard node counts from these names via the same
+    crc32 hash the schedulers use: a rename here that missed the
+    sizing would silently stop gang auto-sizing being oversized."""
+    return f"n{i:04d}"
+
+
 def _build_node(name, alloc):
     from volcano_tpu.apis import core
 
@@ -587,13 +595,21 @@ def _build_queue(name):
 
 def run_phase(topo: LoadgenTopology, rate: float, duration: float,
               tasks_per_job: int, cpu: str, drain_timeout: float,
-              label: str = "run") -> dict:
+              label: str = "run", gang_every: int = 0,
+              gang_size: int = 0, gang_cpu: str = "") -> dict:
     """Open-loop arrivals at ``rate`` jobs/sec for ``duration`` seconds;
-    returns the phase's latency/throughput report."""
+    returns the phase's latency/throughput report.  With ``gang_every``
+    set, every Nth arrival is an OVERSIZED gang (``gang_size`` tasks of
+    ``gang_cpu`` each, minMember == size — sized larger than any one
+    shard can hold, so binding it requires a cross-shard txn_commit
+    assembly); the report then carries per-gang full-assembly latency
+    (submit → LAST member bound) and the partial-gang count, which the
+    exit gate requires to be zero."""
     n_jobs = max(int(rate * duration), 1)
     interval = 1.0 / rate
     submit_ts: Dict[str, float] = {}
     all_keys: List[str] = []
+    gangs: Dict[str, tuple] = {}
     late = 0
 
     start = time.monotonic()
@@ -610,7 +626,12 @@ def run_phase(topo: LoadgenTopology, rate: float, duration: float,
         # generator falls behind, the lag counts as system latency
         # instead of being silently absorbed (coordinated omission)
         t_submit = wall0 + (due - start)
-        keys = topo.submit_job(f"{label}-j{i:06d}", tasks_per_job, cpu)
+        if gang_every and gang_size > 1 and i % gang_every == 0:
+            name = f"{label}-g{i:06d}"
+            keys = topo.submit_job(name, gang_size, gang_cpu)
+            gangs[name] = (keys, t_submit)
+        else:
+            keys = topo.submit_job(f"{label}-j{i:06d}", tasks_per_job, cpu)
         for k in keys:
             submit_ts[k] = t_submit
         all_keys.extend(keys)
@@ -648,6 +669,32 @@ def run_phase(topo: LoadgenTopology, rate: float, duration: float,
         "max_ms": round(float(lat_arr.max()), 3),
         "achieved_pods_per_s": round(bound / span, 1),
     }
+    if gangs:
+        assembly: List[float] = []
+        partial = 0
+        with topo._bind_lock:
+            for _name, (keys, t0) in gangs.items():
+                binds = [topo.bind_ts.get(k) for k in keys]
+                done = [t for t in binds if t is not None]
+                if len(done) == len(keys):
+                    # full-assembly latency: the gang is usable only
+                    # when its LAST member is bound
+                    assembly.append((max(done) - t0) * 1e3)
+                elif done:
+                    partial += 1  # the state txn_commit exists to forbid
+        asm_arr = (
+            np.asarray(assembly) if assembly else np.asarray([float("nan")])
+        )
+        report["gang_mix"] = {
+            "gangs": len(gangs),
+            "gang_size": gang_size,
+            "gang_cpu": gang_cpu,
+            "assembled": len(assembly),
+            "partial_gangs": partial,
+            "assembly_p50_ms": round(float(np.percentile(asm_arr, 50)), 3),
+            "assembly_p99_ms": round(float(np.percentile(asm_arr, 99)), 3),
+            "assembly_max_ms": round(float(asm_arr.max()), 3),
+        }
     n_shards = getattr(topo, "n_shards", 0)
     if n_shards > 1:
         # per-shard percentiles, grouped by each pod's HOME shard (the
@@ -711,10 +758,47 @@ def _warm_names(label: str, n_shards: int):
     return out
 
 
+def _gang_plan(args) -> tuple:
+    """(gang_every, gang_size, gang_cpu) for ``--gang-mix``.  The auto
+    size is deliberately OVERSIZED: larger than the task capacity of
+    the biggest single shard (per-shard node counts come from the same
+    crc32 hash every member uses), so no home shard can ever fit it and
+    every gang exercises the cross-shard txn_commit assembly path."""
+    if args.gang_mix <= 0:
+        return 0, 0, ""
+    gang_every = max(int(round(1.0 / args.gang_mix)), 1)
+    gang_cpu = args.gang_cpu or str(max(args.node_cpu // 2, 1))
+    # gang_cpu is a k8s cpu quantity like --cpu ("500m" or "2") — parse
+    # with the store's own quantity parser so sizing cannot drift from
+    # how the schedulers account the same string
+    from volcano_tpu.apis.quantity import milli_value
+
+    cores = milli_value(gang_cpu) / 1e3
+    slots_per_node = max(int(args.node_cpu / max(cores, 1e-9)), 1)
+    gang_size = args.gang_size
+    if gang_size <= 0:
+        if args.shards > 1:
+            from volcano_tpu.federation.sharding import shard_of_node
+
+            per_shard: Dict[int, int] = {}
+            for i in range(args.nodes):
+                s = shard_of_node(_node_name(i), args.shards)
+                per_shard[s] = per_shard.get(s, 0) + 1
+            gang_size = max(per_shard.values()) * slots_per_node + 1
+        else:
+            gang_size = min(8, args.nodes * slots_per_node)
+    # an infeasible gang (bigger than the whole cluster) would wedge
+    # the drain by design — clamp to what the fleet can ever hold
+    gang_size = min(gang_size, args.nodes * slots_per_node)
+    return gang_every, gang_size, gang_cpu
+
+
 def run_loadgen(args) -> dict:
     with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as f:
         f.write(CONF)
         conf_path = f.name
+
+    gang_every, gang_size, gang_cpu = _gang_plan(args)
 
     def fresh_topo():
         if args.shards > 0:
@@ -790,6 +874,8 @@ def run_loadgen(args) -> dict:
             report = run_phase(
                 topo, rate, args.duration, args.tasks_per_job, args.cpu,
                 args.drain_timeout, label=label,
+                gang_every=gang_every, gang_size=gang_size,
+                gang_cpu=gang_cpu,
             )
             if hasattr(topo, "scheduler"):
                 report.update(_cycle_mix(topo))
@@ -825,6 +911,7 @@ def run_loadgen(args) -> dict:
             "schedule_period_s": args.period,
             "micro_cycles": not args.no_micro_cycles,
             "shards": args.shards,
+            "gang_mix": args.gang_mix,
             "quick": args.quick,
         },
     }
@@ -904,6 +991,23 @@ def main(argv=None) -> int:
                    "into the measured stream (bus HA drill: a follower "
                    "must promote within one lease TTL, every pod must "
                    "still bind, and no pod may be re-bound)")
+    p.add_argument("--gang-mix", type=float, default=0.0,
+                   help="fraction of arrivals submitted as OVERSIZED "
+                   "gangs (minMember == size, auto-sized LARGER than "
+                   "any single shard's task capacity) — each one must "
+                   "bind via a cross-shard txn_commit assembly; the "
+                   "exit gate requires zero partial gangs and the "
+                   "report carries full-assembly latency percentiles "
+                   "(0 = none; meant for --shards >= 2)")
+    p.add_argument("--gang-size", type=int, default=0,
+                   help="gang task count (0 = auto: biggest shard's "
+                   "task capacity + 1)")
+    p.add_argument("--gang-cpu", default="",
+                   help="per-gang-task cpu request (default: half a "
+                   "node, so each node holds two gang tasks)")
+    p.add_argument("--gang-slo-ms", type=float, default=0.0,
+                   help="gate: fail when gang full-assembly p99 "
+                   "exceeds this (0 = report only)")
     p.add_argument("--kill-shard-after", type=float, default=0.0,
                    help="SIGKILL shard member 0 this many seconds into "
                    "the measured stream (federation chaos: survivors "
@@ -919,6 +1023,12 @@ def main(argv=None) -> int:
         args.nodes = 16
         args.node_cpu = 64
         args.drain_timeout = 60.0
+        if args.gang_mix > 0:
+            # gang arrivals are node-sized: 25 jobs/s of half-node
+            # tasks would oversubscribe the 16-node quick fleet many
+            # times over before churn can free it
+            args.rate = 5.0
+            args.drain_timeout = 120.0
 
     report = run_loadgen(args)
     json.dump(report, sys.stdout, indent=2)
@@ -934,6 +1044,18 @@ def main(argv=None) -> int:
         print("LOADGEN FAIL: federation run is not policy-equivalent: "
               f"{r.get('policy_violations')}", file=sys.stderr)
         return 1
+    gm = r.get("gang_mix")
+    if gm is not None:
+        if gm["partial_gangs"]:
+            print(f"LOADGEN FAIL: {gm['partial_gangs']} gangs are "
+                  "PARTIALLY placed — the txn_commit atomicity "
+                  "invariant is broken", file=sys.stderr)
+            return 1
+        if args.gang_slo_ms > 0 and gm["assembly_p99_ms"] > args.gang_slo_ms:
+            print(f"LOADGEN FAIL: gang assembly p99 "
+                  f"{gm['assembly_p99_ms']}ms > SLO {args.gang_slo_ms}ms",
+                  file=sys.stderr)
+            return 1
     if args.apiserver_replicas > 0:
         ha = r.get("bus_ha", {})
         if ha.get("rebinds", 0) != 0:

@@ -376,11 +376,14 @@ class TestCasBind:
             api.cas_bind("ns", "p1", "n1", expected_rv=stale)
 
 
-def _make_fed(api, ident, n_shards, conf, ttl=0.8, spill_after=1):
+def _make_fed(api, ident, n_shards, conf, ttl=0.8, spill_after=1,
+              gang_broker=True, gang_assemble_after=1):
     fed = FederatedScheduler(
         api, ident, n_shards, scheduler_conf_path=conf,
         lease_duration=ttl, lease_retry_period=0.04,
         spill_after=spill_after,
+        gang_broker=gang_broker,
+        gang_assemble_after=gang_assemble_after,
     )
     return fed.start()
 
@@ -429,13 +432,22 @@ class TestSpillover:
             for f in feds:
                 f.stop()
 
-    def test_unsatisfied_gang_never_spills(self, tmp_path):
+    def test_unsatisfied_gang_assembles_cross_shard(self, tmp_path):
+        """THE new behavior pin (replacing the PR 9 refusal pin
+        ``test_unsatisfied_gang_never_spills``): a gang whose home
+        shard cannot fit ``minMember`` no longer stays Pending — the
+        gang broker assembles a full-gang placement (home fills first,
+        foreign claims the remainder) and commits it via ONE atomic
+        ``txn_commit``, so the gang binds across ≥2 shards with the
+        no-partial invariant provable from API truth throughout."""
         api = APIServer()
         kube, vc = KubeClient(api), VolcanoClient(api)
         vc.create_queue(build_queue("default"))
+        # home shard 1: one 2-cpu node (fits ONE 2-cpu task — below the
+        # gang minimum); shard 0 has room for the rest
         for node in _nodes_for_shard(0, 2, 3, cpu="16"):
             kube.create_node(node)
-        for node in _nodes_for_shard(1, 2, 1, cpu="1"):
+        for node in _nodes_for_shard(1, 2, 1, cpu="2"):
             kube.create_node(node)
         feds = [
             _make_fed(api, f"s{i}", 2, _conf(tmp_path)) for i in range(2)
@@ -444,9 +456,66 @@ class TestSpillover:
             for f in feds:
                 assert f.wait_owned(10.0)
             _wait(lambda: sum(len(f.state.owned()) for f in feds) == 2)
-            # a gang of 3 homed on the tiny shard: it can never reach
-            # minMember at home, and spillover must NOT assemble it
-            # across shards
+            jname = _names_for_shard(1, 2, 1, prefix="gang")[0]
+            vc.create_pod_group(build_pod_group("ns", jname, 3))
+            for i in range(3):
+                kube.create_pod(build_pod(
+                    "ns", f"{jname}-t{i}", "",
+                    {"cpu": "2", "memory": "1Gi"}, group=jname,
+                ))
+
+            def all_bound():
+                for f in feds:
+                    f.scheduler.run_once()
+                pods = kube.list_pods("ns")
+                # the invariant holds at EVERY observation: the gang is
+                # never visible partially placed below minMember
+                bound = sum(1 for p in pods if p.spec.node_name)
+                assert bound == 0 or bound >= 3, (
+                    f"partial gang observed: {bound}/3 bound"
+                )
+                return bound == 3
+
+            assert _wait(all_bound, timeout=30.0, interval=0.05), (
+                "gang never assembled across shards"
+            )
+            spanned = {
+                shard_of_node(p.spec.node_name, 2)
+                for p in kube.list_pods("ns")
+            }
+            assert spanned == {0, 1}, (
+                f"expected a cross-shard assembly, got shards {spanned}"
+            )
+            homer = next(f for f in feds if f.state.owns_shard(1))
+            assert homer.broker.counters().get("committed", 0) == 1
+            report = verify_federation(api, 2)
+            assert report["ok"], report["violations"]
+            assert report["checked"]["cross_shard_gangs"] == 1
+        finally:
+            for f in feds:
+                f.stop()
+
+    def test_gang_broker_off_keeps_refusal(self, tmp_path):
+        """The degraded-mode refusal pin: with ``--gang-broker off``
+        (and equally on a pre-v6 bus, where the old-peer txn_commit
+        fallback is an abort) the PR 9 semantics hold exactly — a gang
+        below minMember at home stays Pending, honestly, and never
+        partially escapes its shard."""
+        api = APIServer()
+        kube, vc = KubeClient(api), VolcanoClient(api)
+        vc.create_queue(build_queue("default"))
+        for node in _nodes_for_shard(0, 2, 3, cpu="16"):
+            kube.create_node(node)
+        for node in _nodes_for_shard(1, 2, 1, cpu="1"):
+            kube.create_node(node)
+        feds = [
+            _make_fed(api, f"s{i}", 2, _conf(tmp_path), gang_broker=False)
+            for i in range(2)
+        ]
+        try:
+            for f in feds:
+                assert f.wait_owned(10.0)
+            _wait(lambda: sum(len(f.state.owned()) for f in feds) == 2)
             jname = _names_for_shard(1, 2, 1, prefix="gang")[0]
             vc.create_pod_group(build_pod_group("ns", jname, 3))
             for i in range(3):
@@ -463,6 +532,7 @@ class TestSpillover:
             ), "gang task escaped its home shard below minMember"
             spiller = next(f for f in feds if f.state.owns_shard(1))
             assert spiller.spillover.counters().get("bound", 0) == 0
+            assert spiller.broker is None
         finally:
             for f in feds:
                 f.stop()
@@ -505,6 +575,240 @@ class TestSpillover:
         finally:
             for f in feds:
                 f.stop()
+
+
+class TestGangBroker:
+    """Unit pins for the assembly machinery below the end-to-end pin:
+    the ledger plan (home-first, claim accounting, sketch gating), the
+    sketch solicitation filter, and the broker's discard-whole /
+    park-on-unsupported behavior."""
+
+    @staticmethod
+    def _task(name, cpu="2", ns="ns"):
+        from volcano_tpu.api.job_info import new_task_info
+
+        return new_task_info(build_pod(
+            ns, name, "", {"cpu": cpu, "memory": "1Gi"},
+        ))
+
+    def _rig(self):
+        rig = _FilterRig(n_shards=2)
+        rig.own(0)
+        return rig
+
+    def test_capacity_sketch_tracks_free_capacity(self):
+        rig = self._rig()
+        node = _nodes_for_shard(0, 2, 1, cpu="4")[0]
+        rig.filter.add_node(node)
+        sketch = rig.filter.capacity_sketch()
+        assert sketch["freeSlots"] == 1
+        assert sketch["maxFreeCpuMilli"] == 4000
+        # a 3-cpu resident shrinks the sketch
+        rig.filter.add_pod(build_pod(
+            "ns", "resident", node.metadata.name,
+            {"cpu": "3", "memory": "1Gi"},
+        ))
+        sketch = rig.filter.capacity_sketch()
+        assert sketch["maxFreeCpuMilli"] == 1000
+        # foreign nodes never contribute — the sketch is the OWNED slice
+        rig.filter.add_node(_nodes_for_shard(1, 2, 1, cpu="64")[0])
+        assert rig.filter.capacity_sketch()["maxFreeCpuMilli"] == 1000
+
+    def test_solicitable_shards_prunes_by_sketch(self):
+        from volcano_tpu.federation import solicitable_shards
+
+        rec = {
+            "shards": {"0": {"holder": "m0"}, "1": {"holder": "m1"},
+                       "2": {"holder": "m2"}, "3": {"holder": ""}},
+            "stats": {
+                "m1": {"sketch": {"freeSlots": 0, "maxFreeCpuMilli": 9000,
+                                  "maxFreeMemory": 1 << 40}},
+                "m2": {"sketch": {"freeSlots": 3, "maxFreeCpuMilli": 4000,
+                                  "maxFreeMemory": 1 << 40}},
+            },
+        }
+        want = self._task("t", cpu="2").resreq
+        ok = solicitable_shards(
+            rec, 4, want.get("cpu"), want.get("memory"), own_shards={0}
+        )
+        # m1 has no pod slots left; m2 fits; shard 3 has no holder (no
+        # sketch signal) so it stays solicitable — the sketch only
+        # prunes, never gates correctness
+        assert ok == {2, 3}
+        # a claim too big for every sketch prunes down to the unknowns
+        big = self._task("big", cpu="8").resreq
+        assert solicitable_shards(
+            rec, 4, big.get("cpu"), big.get("memory"), own_shards={0}
+        ) == {3}
+
+    def test_solicitation_minima_are_component_wise(self):
+        """A heterogeneous gang's prune keys are the component-wise
+        minima across tasks, NOT one task's full resreq: keying on the
+        min-CPU task (which may carry the gang's LARGEST memory ask)
+        would prune the only shard able to host a high-cpu/low-memory
+        member."""
+        from volcano_tpu.federation import solicitable_shards
+
+        # shard 1's slice: lots of cpu, little memory — it can host the
+        # gang's big-cpu/small-mem member but not its small-cpu/big-mem
+        # member.  Component-wise minima (cpu=1000, mem=1Gi) keep it
+        # solicitable; the min-CPU task's FULL resreq (cpu=1000,
+        # mem=10Gi) would wrongly prune it.
+        rec = {
+            "shards": {"1": {"holder": "m1"}},
+            "stats": {"m1": {"sketch": {
+                "freeSlots": 2, "maxFreeCpuMilli": 16000,
+                "maxFreeMemory": 2 << 30,
+            }}},
+        }
+        assert solicitable_shards(
+            rec, 2, 1000.0, float(1 << 30), own_shards={0}
+        ) == {1}
+        assert solicitable_shards(
+            rec, 2, 1000.0, float(10 << 30), own_shards={0}
+        ) == set()
+
+    def test_plan_fills_home_first_and_accounts_claims(self):
+        rig = self._rig()
+        home = _nodes_for_shard(0, 2, 1, cpu="4")[0]
+        foreign = _nodes_for_shard(1, 2, 1, cpu="16")[0]
+        rig.filter.add_node(home)
+        rig.filter.add_node(foreign)
+        tasks = [self._task(f"t{i}", cpu="3") for i in range(3)]
+        plan = rig.filter.plan_gang_assembly(tasks)
+        assert len(plan) == 3
+        hosts = [h for _t, h in plan]
+        # home fits exactly ONE 3-cpu claim (4 cpu total): the plan
+        # debits its own claims, so the second task must go foreign
+        assert hosts[0] == home.metadata.name
+        assert hosts.count(home.metadata.name) == 1
+        assert hosts.count(foreign.metadata.name) == 2
+
+    def test_plan_respects_shard_gate(self):
+        rig = self._rig()
+        rig.filter.add_node(_nodes_for_shard(0, 2, 1, cpu="2")[0])
+        rig.filter.add_node(_nodes_for_shard(1, 2, 1, cpu="16")[0])
+        tasks = [self._task(f"t{i}", cpu="2") for i in range(2)]
+        # shard 1 gated out: only the home node places, one task left
+        plan = rig.filter.plan_gang_assembly(
+            tasks, shard_ok=lambda s: False
+        )
+        assert len(plan) == 1
+        assert plan[0][1] in {
+            n.metadata.name for n in _nodes_for_shard(0, 2, 1)
+        }
+
+    def _broker(self, rig, api=None):
+        from volcano_tpu.federation import GangBroker
+
+        return GangBroker(rig.cache, rig.state, rig.filter,
+                          api or rig.api, assemble_after=0)
+
+    def _entry(self, tasks, mm):
+        return {"job_id": "ns/g", "min_member": mm, "ready": 0,
+                "tasks": tasks}
+
+    def test_stale_claim_discards_assembly_whole(self):
+        rig = self._rig()
+        kube = KubeClient(rig.api)
+        for shard, cpu in ((0, "16"), (1, "16")):
+            kube.create_node(_nodes_for_shard(shard, 2, 1, cpu=cpu)[0])
+        tasks = []
+        for i in range(2):
+            kube.create_pod(build_pod(
+                "ns", f"g-t{i}", "", {"cpu": "2", "memory": "1Gi"},
+            ))
+            tasks.append(self._task(f"g-t{i}"))
+        # a foreign racer wins one member between plan and commit
+        rig.api.cas_bind("ns", "g-t1", "raced-elsewhere")
+        broker = self._broker(rig)
+        assert broker._assemble_one(self._entry(tasks, 2), None) is False
+        assert broker.counters() == {"conflict": 1}
+        assert broker._backoff.get("ns/g", 0) > 0  # bounded backoff armed
+        # discarded WHOLE: the placeable member did not bind alone
+        assert rig.api.get("Pod", "ns", "g-t0").spec.node_name == ""
+
+    def test_unsupported_bus_parks_the_broker(self):
+        """The pre-v6 degraded mode: an `unsupported` txn_commit result
+        (the old-peer abort fallback) parks the broker permanently —
+        the honest refusal semantics, with zero binds issued."""
+        rig = self._rig()
+        kube = KubeClient(rig.api)
+        kube.create_node(_nodes_for_shard(0, 2, 1, cpu="16")[0])
+        tasks = []
+        for i in range(2):
+            kube.create_pod(build_pod(
+                "ns", f"g-t{i}", "", {"cpu": "2", "memory": "1Gi"},
+            ))
+            tasks.append(self._task(f"g-t{i}"))
+
+        class PreV6(object):
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            def txn_commit(self, binds=()):
+                return {"committed": False, "objects": [],
+                        "results": ["unsupported"] * len(list(binds)),
+                        "reason": "unsupported"}
+
+        broker = self._broker(rig, api=PreV6(rig.api))
+        assert broker._assemble_one(self._entry(tasks, 2), None) is False
+        assert broker.disabled is True
+        assert broker.run_once() == 0  # parked for good
+        assert all(
+            not p.spec.node_name for p in KubeClient(rig.api).list_pods("ns")
+        )
+
+    def test_halted_broker_assembles_nothing_further(self):
+        """Crash-mode kill semantics: once ``gang.kill_mid_assembly``
+        fires, the member is dead — it must not go on planning or
+        committing OTHER gangs later in the same pass (a SIGKILLed
+        process would not)."""
+        rig = self._rig()
+        kube = KubeClient(rig.api)
+        kube.create_node(_nodes_for_shard(0, 2, 2, cpu="16")[1])
+        entries = []
+        for g in ("ga", "gb"):
+            tasks = []
+            for i in range(2):
+                kube.create_pod(build_pod(
+                    "ns", f"{g}-t{i}", "", {"cpu": "2", "memory": "1Gi"},
+                ))
+                tasks.append(self._task(f"{g}-t{i}"))
+            entries.append({"job_id": f"ns/{g}", "min_member": 2,
+                            "ready": 0, "tasks": tasks})
+        rig.state.owns_job_id = lambda _jid: True
+        broker = self._broker(rig)
+        faults.configure("seed=2;gang.kill_mid_assembly=1:count=1")
+        assert broker.run_once(view=entries) == 0
+        assert broker._halted is True
+        assert all(
+            not p.spec.node_name
+            for p in KubeClient(rig.api).list_pods("ns")
+        ), "a dead member issued binds"
+        # and it stays dead across passes
+        faults.configure(None)
+        assert broker.run_once(view=entries) == 0
+
+    def test_infeasible_counts_and_defers(self):
+        rig = self._rig()
+        kube = KubeClient(rig.api)
+        kube.create_node(_nodes_for_shard(0, 2, 1, cpu="1")[0])
+        tasks = []
+        for i in range(2):
+            kube.create_pod(build_pod(
+                "ns", f"g-t{i}", "", {"cpu": "8", "memory": "1Gi"},
+            ))
+            tasks.append(self._task(f"g-t{i}", cpu="8"))
+        broker = self._broker(rig)
+        assert broker._assemble_one(self._entry(tasks, 2), None) is False
+        assert broker.counters() == {"infeasible": 1}
+        assert all(
+            not p.spec.node_name for p in KubeClient(rig.api).list_pods("ns")
+        )
 
 
 class TestSingleShardEquivalence:
@@ -620,7 +924,7 @@ class FederationCluster:
                 remote, f"m{i}", n_shards,
                 scheduler_conf_path=str(conf),
                 lease_duration=ttl, lease_retry_period=0.04,
-                spill_after=1,
+                spill_after=1, gang_assemble_after=1,
             ).start()
             self.feds.append(fed)
 
@@ -747,6 +1051,103 @@ class TestFederationChaosSmoke:
             cluster.close()
 
 
+class TestGangAssemblyChaos:
+    """The SIGKILL-mid-assembly drill: a member dies between building a
+    cross-shard gang assembly and committing it — the widest window in
+    which a non-atomic protocol would strand a partial gang.  The pin:
+    the orphaned assembly is discarded whole (zero binds — the
+    transaction was never issued) or committed whole (txn atomicity),
+    NEVER partial; survivors absorb the dead member's slices within one
+    lease TTL and the gang still assembles, policy-equivalent."""
+
+    def test_shard_kill_mid_assembly_discards_or_commits_whole(
+        self, tmp_path
+    ):
+        cluster = FederationCluster(tmp_path, "midkill", ttl=0.8)
+        try:
+            for fed in cluster.feds:
+                assert fed.wait_owned(15.0)
+            assert _wait(
+                lambda: sum(
+                    len(f.state.owned()) for f in cluster.feds
+                ) == 3,
+                timeout=10.0,
+            )
+            # a gang larger than ANY single shard: tasks take a full
+            # node each, minMember = (biggest shard's node count) + 1 —
+            # no slice can ever host it alone (not even a survivor that
+            # absorbed the dead member's home shard), so ANY full
+            # placement necessarily spans ≥ 2 shards
+            per_shard = {}
+            for node in cluster.api.list("Node"):
+                s = shard_of_node(node.metadata.name, cluster.n_shards)
+                per_shard[s] = per_shard.get(s, 0) + 1
+            home = min(per_shard, key=lambda s: (per_shard[s], s))
+            mm = max(per_shard.values()) + 1
+            jname = _names_for_shard(
+                home, cluster.n_shards, 1, prefix="bigg"
+            )[0]
+            cluster.submit(jname, replicas=mm, cpu="4")
+            gang_keys = [f"ns/{jname}-t{i}" for i in range(mm)]
+
+            def gang_bound():
+                return sum(
+                    1 for p in cluster.kube.list_pods("ns")
+                    if f"ns/{p.metadata.name}" in
+                    {k for k in gang_keys} and p.spec.node_name
+                )
+
+            # the deterministic kill: the first assembly attempt dies
+            # between planning and committing
+            faults.configure("seed=3;gang.kill_mid_assembly=1:count=1")
+            assert _wait(
+                lambda: (cluster.cycle() or True)
+                and any(f._crashed for f in cluster.feds),
+                timeout=20.0, interval=0.05,
+            ), "mid-assembly kill never fired"
+            faults.configure(None)
+            dead = [f for f in cluster.feds if f._crashed]
+            assert len(dead) == 1
+            # the orphaned assembly was discarded WHOLE: the dying
+            # member never issued the transaction, so zero gang binds
+            assert gang_bound() == 0, (
+                "partial gang escaped a mid-assembly crash"
+            )
+            # survivors absorb within one TTL of expiry and the gang
+            # still assembles — whole, never partial, at every sample
+            dead_ident = dead[0].identity
+
+            def recovered_and_assembled():
+                cluster.cycle()
+                bound = gang_bound()
+                assert bound == 0 or bound >= mm, (
+                    f"partial gang observed during recovery: "
+                    f"{bound}/{mm} bound"
+                )
+                holders = cluster.live_holders()
+                return bound >= mm and all(
+                    h is not None and h != dead_ident
+                    for h in holders.values()
+                )
+
+            assert _wait(
+                recovered_and_assembled,
+                timeout=cluster.ttl * 3 + 30.0, interval=0.05,
+            ), (
+                f"gang never reassembled after the kill "
+                f"(bound {gang_bound()}/{mm})"
+            )
+            assert cluster.rebinds == [], cluster.rebinds
+            report = verify_federation(cluster.api, cluster.n_shards)
+            assert report["ok"], report["violations"]
+            assert report["checked"]["cross_shard_gangs"] >= 1, (
+                "the gang should span shards — its home could not "
+                "fit minMember"
+            )
+        finally:
+            cluster.close()
+
+
 @pytest.mark.slow
 class TestFederationSoak:
     def test_rolling_kills_and_rejoins(self, tmp_path):
@@ -836,7 +1237,11 @@ class TestVtctlShards:
                       "leaseDurationSeconds": 2.0},
             },
             "stats": {"m0": {"nodesOwned": 4, "rebalances": 1,
-                             "spillover": {"bound": 2, "conflict": 1}}},
+                             "spillover": {"bound": 2, "conflict": 1},
+                             "sketch": {"freeCpuMilli": 16000,
+                                        "freeSlots": 4},
+                             "gangAssembly": {"committed": 1,
+                                              "conflict": 2}}},
         }
         api.create(core.ConfigMap(
             metadata=core.ObjectMeta(name=SHARD_MAP_NAME,
@@ -857,6 +1262,8 @@ class TestVtctlShards:
         assert direct.getvalue() == remote.getvalue()
         assert "m0" in direct.getvalue()
         assert "<unheld>" in direct.getvalue()
+        # the gang-assembly line renders from the stats blob alone
+        assert "gang-assembly: committed=1 conflict=2" in direct.getvalue()
 
     def test_shards_without_map(self):
         import io

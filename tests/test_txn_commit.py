@@ -1,0 +1,423 @@
+"""VBUS v6 ``txn_commit`` — atomic multi-object transactions (ISSUE 11).
+
+The cross-shard gang-assembly primitive: N conditional binds
+(``cas_bind`` semantics) checked and applied all-or-nothing under ONE
+store lock hold.  Pins:
+
+* **Atomicity** — every precondition is evaluated before any effect;
+  one stale claim aborts the whole transaction with per-item results
+  (the caller learns exactly which claim went stale) and ZERO binds
+  land.
+* **Wire parity** — the in-process, ``--bus``, and old-peer paths
+  agree; a pre-v6 server degrades the client to an ABORT (reported
+  ``unsupported``), never a per-object replay — version skew costs the
+  feature, never the no-partial-gang invariant.
+* **Durability** — on a persistent store the whole transaction is ONE
+  WAL record (riding the atomic ``commit_batch`` path): recovery
+  replays it whole, an aborted transaction logs nothing, and a WAL
+  write failure rolls every in-memory bind back before the caller sees
+  the error.
+* **Replication** — the record ships to followers as a unit, so every
+  replica holds the gang whole or not at all.
+"""
+
+import json
+import socket
+import time
+
+import pytest
+
+from volcano_tpu import faults
+from volcano_tpu.apis import core
+from volcano_tpu.bus.remote import RemoteAPIServer
+from volcano_tpu.bus.replication import ReplicaManager
+from volcano_tpu.bus.server import BusServer
+from volcano_tpu.bus.wal import (
+    WAL_FILE,
+    PersistentAPIServer,
+    WalError,
+    read_records,
+)
+from volcano_tpu.client import APIServer
+from volcano_tpu.client.apiserver import ApiError
+
+
+def _wait(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _pod(name, ns="ns"):
+    return core.Pod(
+        metadata=core.ObjectMeta(name=name, namespace=ns),
+        spec=core.PodSpec(
+            containers=[core.Container(name="c", image="img")]
+        ),
+        status=core.PodStatus(phase="Pending"),
+    )
+
+
+def _binds(api, names, hosts=None):
+    """Bind items stamped with each pod's CURRENT resourceVersion —
+    the broker's read-back discipline."""
+    out = []
+    for i, name in enumerate(names):
+        pod = api.get("Pod", "ns", name)
+        out.append({
+            "namespace": "ns", "name": name,
+            "hostname": (hosts or {}).get(name, f"n{i}"),
+            "expected_rv": pod.metadata.resource_version,
+        })
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.configure(None)
+    yield
+    faults.configure(None)
+
+
+class TestTxnCommitInProcess:
+    def test_commits_all_under_one_transaction(self):
+        api = APIServer()
+        for i in range(4):
+            api.create(_pod(f"p{i}"))
+        result = api.txn_commit(_binds(api, [f"p{i}" for i in range(4)]))
+        assert result["committed"] is True
+        assert result["results"] == [None] * 4
+        assert [o.spec.node_name for o in result["objects"]] == [
+            "n0", "n1", "n2", "n3"
+        ]
+        for i in range(4):
+            assert api.get("Pod", "ns", f"p{i}").spec.node_name == f"n{i}"
+
+    def test_one_stale_claim_aborts_all_with_per_item_results(self):
+        """The load-bearing atomicity pin: a single already-bound member
+        aborts the WHOLE transaction — zero binds land — and the
+        results name exactly the stale item, so the broker can discard
+        the assembly and retry against fresh truth."""
+        api = APIServer()
+        for name in ("a", "b", "c"):
+            api.create(_pod(name))
+        binds = _binds(api, ["a", "b", "c"])
+        api.cas_bind("ns", "b", "raced-elsewhere")  # the foreign winner
+        result = api.txn_commit(binds)
+        assert result["committed"] is False
+        assert result["objects"] == []
+        assert result["results"][0] is None
+        assert "Conflict" in result["results"][1]
+        assert result["results"][2] is None
+        # preconditions are swept, not short-circuited — and nothing
+        # bound: the state a partially-applied gang would corrupt
+        assert api.get("Pod", "ns", "a").spec.node_name == ""
+        assert api.get("Pod", "ns", "c").spec.node_name == ""
+
+    def test_stale_resource_version_aborts(self):
+        api = APIServer()
+        api.create(_pod("a"))
+        api.create(_pod("b"))
+        binds = _binds(api, ["a", "b"])
+        touched = api.get("Pod", "ns", "b")
+        touched.metadata.labels["x"] = "y"
+        api.update(touched)  # rv moves, pod still unbound
+        result = api.txn_commit(binds)
+        assert result["committed"] is False
+        assert "resourceVersion" in result["results"][1]
+        assert api.get("Pod", "ns", "a").spec.node_name == ""
+
+    def test_missing_member_aborts(self):
+        api = APIServer()
+        api.create(_pod("a"))
+        result = api.txn_commit([
+            {"namespace": "ns", "name": "a", "hostname": "n0"},
+            {"namespace": "ns", "name": "ghost", "hostname": "n1"},
+        ])
+        assert result["committed"] is False
+        assert "NotFound" in result["results"][1]
+        assert api.get("Pod", "ns", "a").spec.node_name == ""
+
+    def test_duplicate_claims_for_one_pod_abort(self):
+        """Two claims for the same pod in one transaction abort: the
+        sequential cas_bind equivalent would conflict on the second,
+        and committing last-write-wins would let a buggy planner
+        believe two gang slots landed when one did."""
+        api = APIServer()
+        api.create(_pod("a"))
+        api.create(_pod("dup"))
+        result = api.txn_commit([
+            {"namespace": "ns", "name": "a", "hostname": "n0"},
+            {"namespace": "ns", "name": "dup", "hostname": "n1"},
+            {"namespace": "ns", "name": "dup", "hostname": "n2"},
+        ])
+        assert result["committed"] is False
+        assert result["results"][0] is None
+        assert result["results"][1] is None
+        assert "duplicate claim" in result["results"][2]
+        assert api.get("Pod", "ns", "a").spec.node_name == ""
+        assert api.get("Pod", "ns", "dup").spec.node_name == ""
+
+    def test_missing_hostname_aborts_before_any_effect(self):
+        """A malformed item (no hostname — the wire hands client
+        payloads straight to the store) must abort in the precondition
+        SWEEP: failing in the apply loop would land earlier binds
+        first, creating a durable partial gang."""
+        api = APIServer()
+        api.create(_pod("a"))
+        api.create(_pod("b"))
+        result = api.txn_commit([
+            {"namespace": "ns", "name": "a", "hostname": "n0"},
+            {"namespace": "ns", "name": "b"},
+        ])
+        assert result["committed"] is False
+        assert result["results"][0] is None
+        assert "hostname" in result["results"][1]
+        assert api.get("Pod", "ns", "a").spec.node_name == ""
+        assert api.get("Pod", "ns", "b").spec.node_name == ""
+
+    def test_empty_transaction_commits_trivially(self):
+        result = APIServer().txn_commit([])
+        assert result == {"committed": True, "results": [], "objects": []}
+
+
+class TestTxnCommitOverBus:
+    def test_wire_parity_commit_and_abort(self):
+        api = APIServer()
+        srv = BusServer(api).start()
+        client = RemoteAPIServer(f"tcp://127.0.0.1:{srv.port}", timeout=5)
+        try:
+            assert client.wait_ready(5)
+            for name in ("a", "b"):
+                client.create(_pod(name))
+            result = client.txn_commit(_binds(api, ["a", "b"]))
+            assert result["committed"] is True
+            assert [o.spec.node_name for o in result["objects"]] == [
+                "n0", "n1"
+            ]
+            assert api.get("Pod", "ns", "a").spec.node_name == "n0"
+            # abort parity: stale claims come back per-item, zero binds
+            for name in ("c", "d"):
+                client.create(_pod(name))
+            binds = _binds(api, ["c", "d"])
+            api.cas_bind("ns", "d", "raced")
+            result = client.txn_commit(binds)
+            assert result["committed"] is False
+            assert result["results"][0] is None
+            assert "Conflict" in result["results"][1]
+            assert api.get("Pod", "ns", "c").spec.node_name == ""
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_old_server_aborts_never_partial(self, monkeypatch):
+        """A pre-v6 server answers ``unknown bus op`` — the client
+        degrades PERMANENTLY (per connection) to an ABORT with every
+        item marked unsupported.  There is deliberately NO per-object
+        fallback: a replay of single binds could die halfway and strand
+        a partial gang, the exact state the op exists to forbid."""
+        real_execute = BusServer._execute
+
+        def v5_execute(self, conn, req_id, payload, op):
+            if op == "txn_commit":
+                raise ApiError("unknown bus op 'txn_commit'")
+            return real_execute(self, conn, req_id, payload, op)
+
+        monkeypatch.setattr(BusServer, "_execute", v5_execute)
+        api = APIServer()
+        srv = BusServer(api).start()
+        client = RemoteAPIServer(f"tcp://127.0.0.1:{srv.port}", timeout=5)
+        try:
+            assert client.wait_ready(5)
+            for name in ("a", "b"):
+                client.create(_pod(name))
+            result = client.txn_commit(_binds(api, ["a", "b"]))
+            assert result["committed"] is False
+            assert result["reason"] == "unsupported"
+            assert len(result["results"]) == 2
+            assert all("unsupported" in r for r in result["results"])
+            assert client._no_txn_commit is True
+            # and NOTHING bound — no partial replay happened
+            assert api.get("Pod", "ns", "a").spec.node_name == ""
+            assert api.get("Pod", "ns", "b").spec.node_name == ""
+        finally:
+            client.close()
+            srv.stop()
+
+
+class TestTxnCommitDurability:
+    def test_whole_transaction_is_one_wal_record_replayed_whole(
+        self, tmp_path
+    ):
+        data_dir = str(tmp_path / "wal")
+        api = PersistentAPIServer(data_dir, snapshot_every=10_000)
+        try:
+            for i in range(3):
+                api.create(_pod(f"p{i}"))
+            wal = str(tmp_path / "wal" / WAL_FILE)
+            before = len(read_records(wal)[0])
+            result = api.txn_commit(_binds(api, ["p0", "p1", "p2"]))
+            assert result["committed"] is True
+            records = read_records(wal)[0]
+            assert len(records) == before + 1, (
+                "the gang must be ONE atomic record, not one per bind"
+            )
+            last = json.loads(records[-1].decode())
+            assert len(last["events"]) == 3
+            assert all(e[1] == "MODIFIED" for e in last["events"])
+        finally:
+            api.close()
+        # recovery replays the record whole: all three bound
+        recovered = PersistentAPIServer(data_dir, snapshot_every=10_000)
+        try:
+            for i in range(3):
+                pod = recovered.get("Pod", "ns", f"p{i}")
+                assert pod.spec.node_name == f"n{i}"
+        finally:
+            recovered.close()
+
+    def test_abort_logs_nothing(self, tmp_path):
+        api = PersistentAPIServer(str(tmp_path / "wal"),
+                                  snapshot_every=10_000)
+        try:
+            api.create(_pod("a"))
+            api.create(_pod("b"))
+            binds = _binds(api, ["a", "b"])
+            api.cas_bind("ns", "b", "raced")
+            wal = str(tmp_path / "wal" / WAL_FILE)
+            before = len(read_records(wal)[0])
+            result = api.txn_commit(binds)
+            assert result["committed"] is False
+            assert len(read_records(wal)[0]) == before
+            assert api.get("Pod", "ns", "a").spec.node_name == ""
+        finally:
+            api.close()
+
+    def test_wal_write_failure_rolls_back_every_bind(self, tmp_path):
+        """The crash shape in between: the transaction's record never
+        became durable, so the op is NOT acked and the in-memory binds
+        are rolled back — a reader can never observe a gang a restart
+        would erase (half or whole)."""
+        api = PersistentAPIServer(str(tmp_path / "wal"),
+                                  snapshot_every=10_000)
+        try:
+            for name in ("a", "b"):
+                api.create(_pod(name))
+            binds = _binds(api, ["a", "b"])
+            faults.configure("seed=1;wal.write_fail=1:count=1")
+            with pytest.raises(WalError):
+                api.txn_commit(binds)
+            faults.configure(None)
+            assert api.get("Pod", "ns", "a").spec.node_name == ""
+            assert api.get("Pod", "ns", "b").spec.node_name == ""
+            # the store is healthy again: the same transaction commits
+            result = api.txn_commit(_binds(api, ["a", "b"]))
+            assert result["committed"] is True
+        finally:
+            api.close()
+
+
+class TestTxnCommitReplication:
+    def test_gang_is_one_atomic_record_on_every_replica(self, tmp_path):
+        """3-replica group: a txn_commit issued through a FOLLOWER
+        connection (proxied to the leader) lands on every replica as
+        one record carrying all the binds — no replica can ever hold
+        half the gang, which is what makes the gang survive failover
+        whole."""
+        ports = [_free_port() for _ in range(3)]
+        endpoints = [f"tcp://127.0.0.1:{p}" for p in ports]
+        replicas = []
+        for i in range(3):
+            store = PersistentAPIServer(str(tmp_path / f"r{i}"),
+                                        snapshot_every=10_000)
+            mgr = ReplicaManager(store, endpoints, i, lease_ttl=1.0)
+            bus = BusServer(store, port=ports[i], replica=mgr)
+            bus.start()
+            mgr.start()
+            replicas.append((store, mgr, bus))
+        cli = None
+        try:
+            def roles():
+                return [m.role for _s, m, _b in replicas]
+
+            assert _wait(
+                lambda: roles().count("leader") == 1
+                and roles().count("follower") == 2,
+                timeout=20.0,
+            ), roles()
+            fidx = next(i for i, (_s, m, _b) in enumerate(replicas)
+                        if m.role == "follower")
+            cli = RemoteAPIServer(endpoints[fidx], timeout=10)
+            assert cli.wait_ready(10)
+            for name in ("g0", "g1", "g2"):
+                cli.create(_pod(name))
+            binds = []
+            for i, name in enumerate(("g0", "g1", "g2")):
+                pod = cli.get("Pod", "ns", name)
+                binds.append({
+                    "namespace": "ns", "name": name, "hostname": f"n{i}",
+                    "expected_rv": pod.metadata.resource_version,
+                })
+            result = cli.txn_commit(binds)
+            assert result["committed"] is True, result
+
+            def all_replicated():
+                for store, _m, _b in replicas:
+                    for i in range(3):
+                        pod = store.get("Pod", "ns", f"g{i}")
+                        if pod is None or pod.spec.node_name != f"n{i}":
+                            return False
+                return True
+
+            assert _wait(all_replicated, timeout=10.0), (
+                "gang did not replicate whole"
+            )
+            # the transaction is one record in every replica's WAL
+            for i in range(3):
+                wal = str(tmp_path / f"r{i}" / WAL_FILE)
+                gang_records = [
+                    rec for rec in (
+                        json.loads(p.decode())
+                        for p in read_records(wal)[0]
+                    )
+                    if any(
+                        (e[3] or {}).get("metadata", {}).get("name")
+                        == "g0"
+                        and e[1] == "MODIFIED"
+                        for e in rec["events"]
+                    )
+                ]
+                assert len(gang_records) == 1, (
+                    f"replica {i}: gang bind spread over "
+                    f"{len(gang_records)} records"
+                )
+                assert len(gang_records[0]["events"]) == 3
+        finally:
+            if cli is not None:
+                cli.close()
+            for _store, mgr, bus in replicas:
+                try:
+                    mgr.stop()
+                    bus.stop()
+                    _store.close()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+
+
+class TestOpRegistry:
+    def test_txn_commit_is_version_registered(self):
+        """The PR 7 machine-checked rule's anchor: the op is declared at
+        v6 and the protocol speaks v6."""
+        from volcano_tpu.bus import protocol
+
+        assert protocol.OP_VERSIONS["txn_commit"] == 6
+        assert protocol.VERSION >= 6

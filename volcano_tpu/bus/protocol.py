@@ -56,19 +56,28 @@ MAGIC = b"VBUS"
 #: (role / leader / term / WAL + replication introspection — the
 #: ``vtctl bus status`` op) and the leader/follower log-shipping ops
 #: ``repl_append`` / ``repl_snapshot`` / ``repl_commit``
-#: (bus/replication.py).  The frame LAYOUT is unchanged throughout, so
-#: frames are STAMPED with MIN_VERSION — a v1 peer accepts every frame
-#: at the framing layer, and a newer client talking to an older server
-#: detects the unknown op from the typed error and falls back
+#: (bus/replication.py).  v6 adds ``txn_commit``: an atomic
+#: multi-object transaction — N ``cas_bind``s checked and applied
+#: all-or-nothing under one store lock hold, logged as ONE WAL record
+#: and replicated as a unit — the cross-shard gang-assembly primitive
+#: (federation/broker.py).  The frame LAYOUT is unchanged throughout,
+#: so frames are STAMPED with MIN_VERSION — a v1 peer accepts every
+#: frame at the framing layer, and a newer client talking to an older
+#: server detects the unknown op from the typed error and falls back
 #: (per-object binds for ``commit_batch``; a plain ``watch`` for
 #: ``watch_batch``; get + CAS ``update`` for ``cas_bind``; a degraded
 #: ``role: unknown`` payload for ``bus_status`` — bus/remote.py.  An
 #: old peer cannot be a replica at all, so the repl ops have no
 #: fallback to degrade to: a replica group must be version-homogeneous
-#: and a follower simply logs and retries against an old leader).
+#: and a follower simply logs and retries against an old leader.
+#: ``txn_commit``'s fallback is an ABORT, never a per-object replay: a
+#: v5 peer cannot apply half a gang atomically, so the client reports
+#: the whole transaction unsupported and the gang broker stays in the
+#: honest pre-v6 refusal mode — version skew costs the cross-shard
+#: gang feature, never the no-partial-gang invariant).
 #: VERSION is the protocol revision this build speaks; receivers
 #: accept [MIN_VERSION, VERSION].
-VERSION = 5
+VERSION = 6
 #: oldest frame version this build still decodes — and the version
 #: outgoing frames carry, since the layout has not changed since v1
 MIN_VERSION = 1
@@ -132,6 +141,7 @@ OP_VERSIONS: Dict[str, int] = {
     "repl_append": 5,
     "repl_snapshot": 5,
     "repl_commit": 5,
+    "txn_commit": 6,
 }
 
 #: wire error name → exception class; unknown names fall back to ApiError

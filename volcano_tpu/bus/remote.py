@@ -147,6 +147,12 @@ class RemoteAPIServer:
         #: set once a server rejects the v4 ``cas_bind`` op — spillover
         #: binds then degrade to the get + CAS-update equivalent
         self._no_cas_bind = False
+        #: set once a server rejects the v6 ``txn_commit`` op — atomic
+        #: multi-object transactions then ABORT (reported unsupported),
+        #: never replay per-object: a pre-v6 peer cannot apply half a
+        #: gang atomically, so the gang broker degrades to the honest
+        #: pre-v6 refusal mode instead
+        self._no_txn_commit = False
         #: set once a server rejects the v5 ``bus_status`` op — status
         #: queries then answer a degraded ``role: unknown`` payload
         self._no_bus_status = False
@@ -615,6 +621,55 @@ class RemoteAPIServer:
             )
         pod.spec.node_name = hostname
         return self.update(pod, expected_rv=pod.metadata.resource_version)
+
+    def txn_commit(self, binds=()):
+        """Atomic multi-``cas_bind`` transaction (protocol v6): N
+        conditional binds checked and applied all-or-nothing in one
+        server-side store lock hold — the cross-shard gang-assembly
+        primitive.  Returns the ``{committed, results, objects}`` shape
+        of :meth:`client.apiserver.APIServer.txn_commit`.
+
+        A pre-v6 server answers ``unknown bus op``; the client then
+        degrades PERMANENTLY (per connection lifetime) to an ABORT —
+        ``committed: False`` with every item marked unsupported and
+        ``reason: "unsupported"`` — and NEVER to a per-object replay: a
+        sequence of single binds against an old peer could crash or
+        conflict halfway and strand a partial gang, which is exactly
+        the state the transaction exists to forbid.  Version skew costs
+        the cross-shard gang feature, never the no-partial-gang
+        invariant (the caller stays in the pre-v6 refusal mode)."""
+        binds = list(binds)
+        if not self._no_txn_commit:
+            try:
+                resp = self._call({"op": "txn_commit", "binds": binds})
+                return {
+                    "committed": resp["committed"],
+                    "results": resp["results"],
+                    "objects": [
+                        protocol.decode_obj(d)
+                        for d in resp.get("objects", ())
+                    ],
+                }
+            except BusError:
+                raise  # transport failure — NOT a capability signal
+            except ApiError as e:
+                if "unknown bus op" not in str(e):
+                    raise
+                log.warning(
+                    "bus %s does not speak txn_commit (old peer); "
+                    "atomic multi-object transactions abort — no "
+                    "per-object fallback can be atomic", self.address,
+                )
+                self._no_txn_commit = True
+        return {
+            "committed": False,
+            "results": [
+                "unsupported: pre-v6 bus cannot apply an atomic "
+                "multi-object transaction"
+            ] * len(binds),
+            "objects": [],
+            "reason": "unsupported",
+        }
 
     def record_event(
         self,

@@ -580,7 +580,7 @@ class BusServer:
     #: would spuriously conflict on follower lag.
     _LEADER_OPS = frozenset({
         "create", "update", "update_status", "delete",
-        "cas_bind", "commit_batch", "get",
+        "cas_bind", "commit_batch", "txn_commit", "get",
     })
 
     def _execute(self, conn: _Conn, req_id: int, payload: dict, op: str):
@@ -661,6 +661,20 @@ class BusServer:
                 expected_rv=payload.get("expected_rv"),
             )
             return {"object": protocol.encode_obj(obj)}
+        if op == "txn_commit":
+            # v6: the atomic multi-cas_bind transaction — every
+            # precondition checked before any effect, all binds applied
+            # under one store lock hold (a persistent store logs them as
+            # ONE WAL record), per-item conflict results on abort.  The
+            # cross-shard gang-assembly primitive.
+            result = api.txn_commit(payload.get("binds", ()))
+            return {
+                "committed": result["committed"],
+                "results": result["results"],
+                "objects": [
+                    protocol.encode_obj(o) for o in result.get("objects", ())
+                ],
+            }
         if op == "watch":
             self._handle_watch(conn, req_id, payload)
             return None  # responses pushed inline for ordering

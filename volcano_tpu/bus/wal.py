@@ -487,6 +487,18 @@ class PersistentAPIServer(APIServer):
                 conditions=conditions, pod_groups=pod_groups,
             )
 
+    def txn_commit(self, binds=()):
+        """The atomic multi-``cas_bind`` transaction as ONE WAL record:
+        all N bind events buffer through ``_txn`` and land in a single
+        fsynced record (the exact atomic ``commit_batch`` path), so
+        replication ships the gang as a unit and recovery replays it
+        whole or not at all — a crash can never resurrect half a gang.
+        An aborted transaction mutates nothing and therefore logs
+        nothing."""
+        self._check_writable()
+        with self._txn():
+            return super().txn_commit(binds=binds)
+
     # ---- commit path ----
 
     def _commit_txn(self, events: List[tuple]) -> int:

@@ -444,6 +444,15 @@ def _shards(vc: VolcanoClient, args, out) -> int:
                 f"spillover: {spill_txt}",
                 file=out,
             )
+            # cross-shard gang assembly (federation/broker.py) — only
+            # members running the broker publish the blob, so the line
+            # is absent (not zeroed) for --gang-broker off members
+            gang = s.get("gangAssembly")
+            if gang is not None:
+                gang_txt = " ".join(
+                    f"{k}={gang[k]}" for k in sorted(gang)
+                ) or "<none>"
+                print(f"  {'':<22}gang-assembly: {gang_txt}", file=out)
     return 0
 
 

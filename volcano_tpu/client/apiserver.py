@@ -279,6 +279,90 @@ class APIServer:
             bound.spec.node_name = hostname
             return self.update_status(bound)
 
+    def txn_commit(self, binds=()) -> Dict[str, object]:
+        """Atomic multi-object transaction: apply N ``cas_bind``s
+        all-or-nothing under ONE store lock hold — the product of
+        ``commit_batch`` (N effects, one transaction) and ``cas_bind``
+        (conditional single-object bind), and the primitive cross-shard
+        gang assembly stands on (federation/broker.py): a gang placed
+        partly at home and partly on foreign shards either binds whole
+        or not at all, so no observer — watcher, scheduler, or a crash
+        — can ever see a partial gang.
+
+        ``binds`` items: ``{namespace, name, hostname, expected_rv?}``.
+        Every precondition (pod exists, still unbound, resourceVersion
+        matches when given) is checked before ANY effect lands; the
+        return is::
+
+            {"committed": bool,
+             "results": [None | "<error>" per item, input order],
+             "objects": [bound pods] when committed, else []}
+
+        On abort the per-item results say exactly which claim went
+        stale (the caller discards the whole assembly and retries with
+        fresh truth — the Omega conflict model, gang-sized).  Like the
+        binding subresource it skips admission.  The persistent store
+        overrides this to log the whole transaction as ONE WAL record
+        riding the atomic ``commit_batch`` path, replicated and
+        quorum-acked as a unit."""
+        binds = list(binds)
+        with self._lock:
+            results: List[Optional[str]] = []
+            pods = []
+            seen: set = set()
+            for b in binds:
+                key = f"{b['namespace']}/{b['name']}"
+                pod = self._store.get("Pod", {}).get(key)
+                err = None
+                if key in seen:
+                    # two claims for one pod in one transaction: the
+                    # sequential cas_bind equivalent would conflict on
+                    # the second — committing last-write-wins would let
+                    # a buggy planner believe two slots landed
+                    err = (
+                        f"ConflictError: duplicate claim for Pod {key} "
+                        f"in one transaction"
+                    )
+                elif not b.get("hostname"):
+                    # malformed items must abort in the SWEEP — a
+                    # KeyError in the apply loop would land after
+                    # earlier binds, creating the durable partial gang
+                    # this op exists to forbid (the wire hands client
+                    # payloads straight here)
+                    err = (
+                        f"ApiError: bind item for Pod {key} is missing "
+                        f"a hostname"
+                    )
+                elif pod is None:
+                    err = f"NotFoundError: Pod {key} not found"
+                elif pod.spec.node_name:
+                    err = (
+                        f"ConflictError: pod {key} already bound to "
+                        f"{pod.spec.node_name}"
+                    )
+                elif (
+                    b.get("expected_rv") is not None
+                    and pod.metadata.resource_version != b["expected_rv"]
+                ):
+                    err = (
+                        f"ConflictError: Pod {key} resourceVersion "
+                        f"{pod.metadata.resource_version} != expected "
+                        f"{b['expected_rv']}"
+                    )
+                seen.add(key)
+                results.append(err)
+                pods.append(pod)
+            if any(results):
+                return {"committed": False, "results": results,
+                        "objects": []}
+            out = []
+            for b, pod in zip(binds, pods):
+                bound = pod.clone()
+                bound.spec.node_name = b["hostname"]
+                out.append(self.update_status(bound))
+            return {"committed": True, "results": [None] * len(binds),
+                    "objects": out}
+
     # ---- coalesced commit transaction (the multi-bind frame) ----
 
     def commit_batch(

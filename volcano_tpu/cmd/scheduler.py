@@ -46,6 +46,7 @@ class SchedulerDaemon(BaseDaemon):
         shards: int = 0,
         shard_identity: str = "",
         shard_lease_duration: float = 2.0,
+        gang_broker: bool = True,
         **daemon_kw,
     ):
         # /explain reads self.cache lazily (set right below) — the
@@ -78,6 +79,7 @@ class SchedulerDaemon(BaseDaemon):
                 pipelined_commit=pipelined_commit,
                 snapshot_reuse=snapshot_reuse,
                 scheduler_name=scheduler_name,
+                gang_broker=gang_broker,
                 kill_mode="exit",  # shard.kill hard-exits the process
             )
             self.elector = None
@@ -223,6 +225,14 @@ def main(argv=None) -> int:
         "absorbed by survivors within one TTL",
     )
     parser.add_argument(
+        "--gang-broker", choices=("on", "off"), default="on",
+        help="cross-shard gang assembly: a home-owned gang below "
+        "minMember solicits foreign capacity and commits a full-gang "
+        "placement via one atomic txn_commit (VBUS v6).  'off' keeps "
+        "the pre-v6 refusal semantics: such a gang stays Pending at "
+        "home, honestly, never partially placed",
+    )
+    parser.add_argument(
         "--warmup", action="store_true",
         help="compile the headline-bucket session kernels before the "
         "first cycle (first compile is ~20-40s on TPU; same flag as "
@@ -299,6 +309,7 @@ def main(argv=None) -> int:
             shards=args.shards,
             shard_identity=args.shard_identity,
             shard_lease_duration=args.shard_lease_duration,
+            gang_broker=args.gang_broker == "on",
             listen_host=args.listen_host,
             listen_port=args.listen_port,
             leader_elect=args.leader_elect,

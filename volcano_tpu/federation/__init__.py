@@ -22,13 +22,18 @@ Pieces:
   when ownership moves; also feeds the foreign-node spillover ledger.
 * :mod:`spillover` — ``SpilloverController``: home-shard-stuck tasks
   CAS-bind onto foreign-shard nodes with bounded retry on conflict.
+* :mod:`broker` — ``GangBroker``: cross-shard gang assembly — a
+  home-owned gang below ``minMember`` solicits foreign capacity
+  (sketch-gated, O(shards)) and commits a full-gang placement via one
+  atomic VBUS v6 ``txn_commit``; conflicts discard the assembly WHOLE
+  and retry with bounded backoff, so a partial gang can never exist.
 * :mod:`runtime` — ``FederatedScheduler``: one federation member
-  (cache + filter + leases + spillover + scheduler), the unit
+  (cache + filter + leases + spillover + broker + scheduler), the unit
   ``vtpu-scheduler --shards N`` runs and the tests/loadgen harnesses
   instantiate in-process.
 * :mod:`verify` — the multi-shard policy-equivalence checker (each pod
-  bound at most once, binds satisfy predicates, gang minMember honored
-  within home shards).
+  bound at most once, binds satisfy predicates, no gang partially
+  placed below minMember — proven ACROSS shards from API truth).
 """
 
 from volcano_tpu.federation.sharding import (  # noqa: F401
@@ -41,6 +46,10 @@ from volcano_tpu.federation.leases import (  # noqa: F401
     SHARD_MAP_KEY,
     SHARD_MAP_NAME,
     ShardLeaseManager,
+)
+from volcano_tpu.federation.broker import (  # noqa: F401
+    GangBroker,
+    solicitable_shards,
 )
 from volcano_tpu.federation.runtime import FederatedScheduler  # noqa: F401
 from volcano_tpu.federation.verify import verify_federation  # noqa: F401

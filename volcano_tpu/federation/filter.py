@@ -502,39 +502,58 @@ class ShardInformerFilter:
         with self._lock:
             return len(self._fwd_nodes)
 
+    def _capacity_entries(self) -> List[list]:
+        # requires-lock: self._lock
+        """``[free_cpu, name, node, free, slots]`` for every
+        schedulable ledger node with pod slots left — the ONE copy of
+        the node-eligibility + free-capacity math shared by
+        ``spill_candidates`` and ``plan_gang_assembly``, so a fix to
+        either's view of "can this node take a claim" cannot drift
+        from the other's."""
+        out = []
+        for name, node in self._nodes.items():
+            if node.spec.unschedulable:
+                continue
+            alloc = self._node_alloc.get(name)
+            if alloc is None:
+                continue
+            slots = alloc.max_task_num - self._node_ntasks.get(name, 0)
+            if slots <= 0:
+                continue
+            free = alloc.clone()
+            used = self._node_used.get(name)
+            if used is not None:
+                free.sub_unchecked(used)
+            out.append([free.get("cpu"), name, node, free, slots])
+        return out
+
+    @staticmethod
+    def _task_fits(task, node, free) -> bool:
+        """Per-claim fit: resources against the free view, selector +
+        taints via the plugin predicate helpers."""
+        from volcano_tpu.plugins import util as putil
+
+        if not task.resreq.less_equal(free):
+            return False
+        pod = task.pod
+        return pod is None or (
+            putil.pod_matches_node_selector(pod, node)
+            and putil.pod_tolerates_node_taints(pod, node)
+        )
+
     def spill_candidates(self, task, limit: int = 8) -> List[str]:
         """Foreign nodes that could host ``task`` right now, by the
         ledger's capacity view: resource fit against allocatable minus
         summed active requests, node schedulable, selector + taints
         hold.  Most-free-CPU first (a deterministic spread that avoids
         dogpiling one node), capped at ``limit``."""
-        from volcano_tpu.plugins import util as putil
-
-        pod = task.pod
         out = []
         with self._lock:
-            for name, node in self._nodes.items():
+            for free_cpu, name, node, free, _slots in self._capacity_entries():
                 if self.state.owns_node(name):
                     continue
-                if node.spec.unschedulable:
-                    continue
-                alloc = self._node_alloc.get(name)
-                if alloc is None:
-                    continue
-                if self._node_ntasks.get(name, 0) >= alloc.max_task_num:
-                    continue
-                used = self._node_used.get(name)
-                free = alloc.clone()
-                if used is not None:
-                    free.sub_unchecked(used)
-                if not task.resreq.less_equal(free):
-                    continue
-                if pod is not None and not (
-                    putil.pod_matches_node_selector(pod, node)
-                    and putil.pod_tolerates_node_taints(pod, node)
-                ):
-                    continue
-                out.append((free.get("cpu"), name))
+                if self._task_fits(task, node, free):
+                    out.append((free_cpu, name))
         out.sort(key=lambda t: (-t[0], t[1]))
         return [name for _free, name in out[:limit]]
 
@@ -545,3 +564,87 @@ class ShardInformerFilter:
         with self._lock:
             self._ledger_pod(pod)
             self._fwd_pods[_pod_key(pod)] = pod
+
+    # ---- gang-assembly support (federation/broker.py) ----
+
+    def capacity_sketch(self) -> dict:
+        """The owned slice's free capacity, summarized to a handful of
+        numbers — piggybacked on the lease-map heartbeat (the member
+        stats blob) so a foreign gang broker can decide whether this
+        shard's slice could plausibly host a claim WITHOUT walking an
+        O(cluster) ledger for shards that obviously cannot.  This is
+        the first bite of the ledger-trim roadmap item: solicitation is
+        O(shards), though the ledger itself is still cluster-sized.
+
+        Fields (cpu in milli, memory in bytes, like Resource):
+        ``freeCpuMilli``/``freeMemory`` — summed free capacity across
+        schedulable owned nodes with pod slots left; ``maxFreeCpuMilli``
+        /``maxFreeMemory`` — the single best node (a gang TASK needs
+        one node that fits it, not an aggregate); ``freeSlots`` — owned
+        nodes that can still take a pod."""
+        free_cpu = free_mem = 0.0
+        max_cpu = max_mem = 0.0
+        slots = 0
+        with self._lock:
+            for cpu, name, _node, free, _slots in self._capacity_entries():
+                if name not in self._fwd_nodes:
+                    continue  # the sketch advertises the OWNED slice
+                c = max(cpu, 0.0)
+                m = max(free.get("memory"), 0.0)
+                free_cpu += c
+                free_mem += m
+                max_cpu = max(max_cpu, c)
+                max_mem = max(max_mem, m)
+                slots += 1
+        return {
+            "freeCpuMilli": round(free_cpu),
+            "freeMemory": round(free_mem),
+            "maxFreeCpuMilli": round(max_cpu),
+            "maxFreeMemory": round(max_mem),
+            "freeSlots": slots,
+        }
+
+    def plan_gang_assembly(self, tasks, shard_ok=None) -> List[Tuple[object, str]]:
+        """Greedy full-gang placement plan over the ledger's capacity
+        view: HOME-owned nodes fill first (the home cycle only refused
+        because the gang could not complete, not because home had no
+        room), foreign claims fill the remainder.  ``shard_ok`` is an
+        optional predicate ``shard_id -> bool`` gating which FOREIGN
+        shards are solicited (the broker derives it from the per-shard
+        capacity sketches on the lease map).  Claims are accounted
+        within the plan — each placement debits its node's free view —
+        so one assembly can never overcommit a node against itself.
+
+        Returns ``[(task, hostname)]`` for every task it could place,
+        in task order; the caller judges sufficiency (and re-verifies
+        everything against store truth via the ``txn_commit``
+        preconditions before anything binds)."""
+        home: List[list] = []
+        foreign: List[list] = []
+        with self._lock:
+            for entry in self._capacity_entries():
+                name = entry[1]
+                owned = self.state.owns_node(name)
+                if not owned and shard_ok is not None and not shard_ok(
+                    shard_of_node(name, self.state.n_shards)
+                ):
+                    continue
+                (home if owned else foreign).append(entry)
+        # most-free-cpu first within each tier (the spill_candidates
+        # spread), name as the deterministic tie-break
+        home.sort(key=lambda e: (-e[0], e[1]))
+        foreign.sort(key=lambda e: (-e[0], e[1]))
+        candidates = home + foreign
+        plan: List[Tuple[object, str]] = []
+        for task in tasks:
+            for entry in candidates:
+                _key, name, node, free, slots = entry
+                if slots <= 0:
+                    continue
+                if not self._task_fits(task, node, free):
+                    continue
+                free.sub_unchecked(task.resreq)
+                entry[4] -= 1
+                plan.append((task, name))
+                break
+        return plan

@@ -4,7 +4,8 @@ Composition (all existing machinery, re-pointed at a slice):
 
     informer feed ─▶ ShardInformerFilter ─▶ SchedulerCache ─▶ Scheduler
                           ▲      │ledger                        │post_cycle
-    ShardLeaseManager ────┘      └──────────▶ SpilloverController
+    ShardLeaseManager ────┘      ├──────────▶ SpilloverController
+                                 └──────────▶ GangBroker (txn_commit)
 
 The scheduler loop itself is untouched: micro-cycles, the pipelined
 commit plane, snapshot reuse, pack caching all run exactly as in the
@@ -26,6 +27,7 @@ from typing import Optional
 
 from volcano_tpu.cache import SchedulerCache
 from volcano_tpu.client import SchedulerClient
+from volcano_tpu.federation.broker import GangBroker
 from volcano_tpu.federation.filter import ShardInformerFilter
 from volcano_tpu.federation.leases import ShardLeaseManager
 from volcano_tpu.federation.sharding import ShardState
@@ -61,6 +63,8 @@ class FederatedScheduler:
         snapshot_reuse: bool = False,
         scheduler_name: str = "volcano-tpu",
         spill_after: int = 2,
+        gang_broker: bool = True,
+        gang_assemble_after: int = 2,
         kill_mode: str = "crash",
     ):
         self.api = api
@@ -80,6 +84,14 @@ class FederatedScheduler:
             self.cache, self.state, self.filter, api,
             spill_after=spill_after,
         )
+        #: cross-shard gang assembly (txn_commit); ``--gang-broker off``
+        #: keeps the PR 9 refusal semantics — a below-minMember gang
+        #: stays Pending at home, honestly
+        self.broker = GangBroker(
+            self.cache, self.state, self.filter, api,
+            assemble_after=gang_assemble_after,
+            kill_hook=self._hard_kill,
+        ) if gang_broker else None
         self.leases = ShardLeaseManager(
             api, identity, n_shards,
             lease_duration=lease_duration,
@@ -116,13 +128,20 @@ class FederatedScheduler:
 
     def _stats(self) -> dict:
         # piggybacks on the renew tick: retry any failed relist, then
-        # publish this member's observability blob into the map object
+        # publish this member's observability blob into the map object.
+        # The free-capacity sketch rides here too — what foreign gang
+        # brokers read instead of walking an O(cluster) ledger for
+        # shards that plainly have no room.
         self.filter.retry_pending_relists()
-        return {
+        out = {
             "nodesOwned": self.filter.owned_node_count(),
             "spillover": self.spillover.counters(),
             "rebalances": self.leases.rebalances,
+            "sketch": self.filter.capacity_sketch(),
         }
+        if self.broker is not None:
+            out["gangAssembly"] = self.broker.counters()
+        return out
 
     # ---- scheduler hook ----
 
@@ -132,14 +151,30 @@ class FederatedScheduler:
         fp = faults.get_plane()
         if fp.enabled and fp.should("shard.kill"):
             log.error("shard.kill fired: %s going down hard", self.identity)
-            if self.kill_mode == "exit":
-                import os
-
-                os._exit(137)  # SIGKILL's exit code — no cleanup, no
-                # lease release; survivors absorb after expiry
-            self.crash()
+            self._hard_kill()
             return
-        self.spillover.run_once()
+        # one O(jobs) pending scan shared by both passes — their
+        # eligibility sets are disjoint (spillover: satisfied/solo
+        # gangs only; broker: below-minMember gangs only), and the
+        # broker re-verifies every claim against store truth anyway
+        view = (
+            self.cache.pending_spill_view()
+            if self.state.n_shards > 1 else []
+        )
+        self.spillover.run_once(view)
+        if self.broker is not None and not self._crashed:
+            self.broker.run_once(view)
+
+    def _hard_kill(self) -> None:
+        """SIGKILL semantics shared by ``shard.kill`` and the broker's
+        ``gang.kill_mid_assembly`` chaos point: hard-exit for daemon
+        processes, crash-stop (leases left to expire) in-process."""
+        if self.kill_mode == "exit":
+            import os
+
+            os._exit(137)  # SIGKILL's exit code — no cleanup, no
+            # lease release; survivors absorb after expiry
+        self.crash()
 
     # ---- lifecycle ----
 

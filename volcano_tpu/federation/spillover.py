@@ -52,6 +52,32 @@ log = get_logger(__name__)
 # drift-prone copy.
 
 
+def account_bound_pod(filter_, cache, api, old, bound, message) -> None:
+    """Post-bind accounting shared by spillover and the gang broker
+    (federation/broker.py): ledger + forward the bound pod immediately
+    (the watch echo reconciles later, and the very next home cycle must
+    not re-place it), then record the audit Event best-effort — ONE
+    copy, so a fix to the accounting-vs-echo race cannot drift between
+    the two cross-shard bind paths."""
+    filter_.note_spill_bind(bound)
+    try:
+        cache.update_pod(old, bound)
+    except Exception as e:  # noqa: BLE001 — accounting races the echo;
+        # the informer delivery converges it
+        log.debug("cross-shard bind accounting: %s", e)
+    try:
+        from volcano_tpu.client.clients import record_event_via
+
+        record_event_via(
+            api, bound.metadata.namespace,
+            {"kind": "Pod", "namespace": bound.metadata.namespace,
+             "name": bound.metadata.name},
+            "Normal", "Scheduled", message,
+        )
+    except ApiError:
+        pass  # audit events are best-effort, like _record_event
+
+
 class SpilloverController:
     """Post-cycle spillover pass for one federation member."""
 
@@ -88,12 +114,17 @@ class SpilloverController:
         with self._ctr_lock:
             self._counters[result] = self._counters.get(result, 0) + 1
 
-    def run_once(self) -> int:
-        """One spillover pass (Scheduler.post_cycle).  Returns how many
-        pods were successfully spilled."""
+    def run_once(self, view=None) -> int:
+        """One spillover pass (Scheduler.post_cycle).  ``view`` is an
+        optional pre-taken ``pending_spill_view()`` — the runtime
+        shares one O(jobs) scan between this pass and the gang broker
+        (their eligibility sets are disjoint: spillover acts only on
+        satisfied-or-solo gangs, the broker only below minMember).
+        Returns how many pods were successfully spilled."""
         if self.state.n_shards <= 1:
             return 0
-        view = self.cache.pending_spill_view()
+        if view is None:
+            view = self.cache.pending_spill_view()
         live = set()
         eligible = []
         for entry in view:
@@ -150,27 +181,11 @@ class SpilloverController:
             self._count("bound")
             log.info("spillover: bound %s/%s to foreign node %s",
                      task.namespace, task.name, hostname)
-            # account immediately — the watch echo reconciles later, and
-            # the very next home cycle must not re-place this pod
-            self.filter.note_spill_bind(bound)
-            try:
-                self.cache.update_pod(pre, bound)
-            except Exception as e:  # noqa: BLE001 — accounting races the
-                # echo; the informer delivery converges it
-                log.debug("spillover cache account: %s", e)
-            try:
-                from volcano_tpu.client.clients import record_event_via
-
-                record_event_via(
-                    self.api, task.namespace,
-                    {"kind": "Pod", "namespace": task.namespace,
-                     "name": task.name},
-                    "Normal", "Scheduled",
-                    f"Successfully assigned {task.namespace}/{task.name}"
-                    f" to {hostname} (cross-shard spillover)",
-                )
-            except ApiError:
-                pass  # audit events are best-effort, like _record_event
+            account_bound_pod(
+                self.filter, self.cache, self.api, pre, bound,
+                f"Successfully assigned {task.namespace}/{task.name}"
+                f" to {hostname} (cross-shard spillover)",
+            )
             return True
         # every candidate CAS-conflicted — bounded retry exhausted; the
         # next post-cycle pass tries again with fresh truth

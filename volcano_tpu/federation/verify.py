@@ -12,13 +12,19 @@ to **policy equivalence** instead, judged entirely from API truth:
 * every bind satisfies the core predicates against the bound node —
   capacity (summed active requests ≤ allocatable, pod count ≤ the pods
   quantity), schedulability, node selector, taints/tolerations;
-* gang semantics hold within home shards: no PodGroup with
+* gang semantics hold **across shards**: no PodGroup with
   ``minMember > 1`` is left partially placed (some tasks bound while
-  others wait) below its minimum.
+  others wait) below its minimum — judged from the cluster-wide pod
+  set, so a gang the broker assembled across N shards is held to
+  exactly the same invariant as a home-only gang, and each violation
+  names the shards the partial placement spans.  The report counts
+  ``cross_shard_gangs`` (gangs whose bound members span ≥ 2 shards),
+  which is how the chaos drills prove an assembly happened at all.
 
 Reads only the API surface, so the same checker runs over the
 in-process store, a ``--bus`` backend, and inside ``bench/loadgen.py
---shards`` where it gates the run.
+--shards`` where it gates the run (and the federation chaos smokes'
+exit gates).
 """
 
 from __future__ import annotations
@@ -103,7 +109,14 @@ def verify_federation(
                 f"{alloc.max_task_num}"
             )
 
-    # ---- gang minMember within home shards ----
+    # ---- gang minMember, proven ACROSS shards ----
+    # Judged from the cluster-wide pod set (API truth), so the
+    # invariant covers every placement path at once: the home gang
+    # loop, surplus spillover, AND the cross-shard broker's txn_commit
+    # assemblies — a transaction that could land part of a gang would
+    # fail here no matter which shards the parts landed on.
+    from volcano_tpu.federation.sharding import shard_of_node
+
     by_group: Dict[str, List] = {}
     for pod in pods:
         group = (pod.metadata.annotations or {}).get(
@@ -113,12 +126,19 @@ def verify_federation(
             by_group.setdefault(
                 f"{pod.metadata.namespace}/{group}", []
             ).append(pod)
+    cross_shard_gangs = 0
     for pg in api.list("PodGroup"):
         mm = pg.spec.min_member or 0
         if mm <= 1:
             continue
         members = by_group.get(pg.key(), [])
-        bound = sum(1 for p in members if p.spec.node_name)
+        placed = [p for p in members if p.spec.node_name]
+        bound = len(placed)
+        spanned = sorted({
+            shard_of_node(p.spec.node_name, n_shards) for p in placed
+        })
+        if len(spanned) > 1:
+            cross_shard_gangs += 1
         pending = sum(
             1 for p in members
             if not p.spec.node_name and p.status.phase == "Pending"
@@ -131,7 +151,8 @@ def verify_federation(
         if bound and pending and bound < mm:
             violations.append(
                 f"podgroup {pg.key()} partially placed: {bound} bound "
-                f"< minMember {mm} with {pending} still pending"
+                f"< minMember {mm} with {pending} still pending "
+                f"(bound members span shards {spanned})"
             )
 
     return {
@@ -142,6 +163,7 @@ def verify_federation(
             "bound": sum(1 for p in pods if p.spec.node_name),
             "nodes": len(nodes),
             "pod_groups": len(by_group),
+            "cross_shard_gangs": cross_shard_gangs,
             "n_shards": n_shards,
         },
     }

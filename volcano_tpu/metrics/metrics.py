@@ -516,6 +516,29 @@ def observe_shard_lease_renew(seconds: float) -> None:
     ).observe(seconds * 1e3)
 
 
+def register_gang_assembly(result: str) -> None:
+    """volcano_gang_assemblies_total{result}: cross-shard gang assembly
+    outcomes (federation/broker.py).  result ∈ {committed (one
+    txn_commit bound the gang whole), conflict (a claim went stale —
+    assembly discarded whole, retried with backoff; the Omega model at
+    gang granularity), aborted (transport/unsupported — incl. the
+    pre-v6 old-peer refusal mode), infeasible (no full-gang placement
+    exists in the ledger's view — the honest Pending outcome)}."""
+    registry.inc(
+        f"{_NAMESPACE}_gang_assemblies_total", {"result": result}
+    )
+
+
+def observe_txn_commit(seconds: float) -> None:
+    """volcano_txn_commit_latency_milliseconds: the atomic multi-object
+    transaction's round trip (VBUS v6) as the gang broker sees it —
+    precondition sweep + N binds + one WAL fsync + quorum ack, over
+    whichever backend the member holds."""
+    registry.histogram(
+        f"{_NAMESPACE}_txn_commit_latency_milliseconds", {}
+    ).observe(seconds * 1e3)
+
+
 # ---- TPU-build additions: per-kernel phase timings ----
 
 def update_kernel_duration(phase: str, seconds: float) -> None:
