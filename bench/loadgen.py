@@ -722,6 +722,32 @@ def run_phase(topo: LoadgenTopology, rate: float, duration: float,
     return report
 
 
+def _stage_breakdown(topo: LoadgenTopology, cap: int = 500) -> dict:
+    """Attribute submit→bind latency to pipeline stages from the
+    flight-recorder spans collected during the run (volcano_tpu/obs):
+    per-stage count / mean / p99 over up to ``cap`` bound pods, plus
+    the telemetry channel's own health (exported vs dropped).  The
+    ``--stage-breakdown`` report CI uploads next to the SLO JSON."""
+    from volcano_tpu import obs
+
+    exp = obs.get_exporter()
+    if exp is not None:
+        exp.flush_all()
+    spans = obs.collect_spans(topo.api)
+    with topo._bind_lock:
+        pods = [
+            tuple(k.split("/", 1)) for k in list(topo.bind_ts)[:cap]
+            if "-warm-" not in k
+        ]
+    out = obs.stage_breakdown(spans, pods)
+    out["spans_collected"] = len(spans)
+    if exp is not None:
+        out["spans_exported"] = exp.exported
+        out["spans_dropped"] = exp.dropped
+    obs.disable()
+    return out
+
+
 def _cycle_mix(topo: LoadgenTopology) -> dict:
     from volcano_tpu.metrics import metrics
 
@@ -871,6 +897,15 @@ def run_loadgen(args) -> dict:
                 )
                 killer.daemon = True
                 killer.start()
+            if args.stage_breakdown and hasattr(topo, "scheduler"):
+                # flight recorder on the in-process scheduler: spans
+                # batch to the topology's store; attribution runs AFTER
+                # the drain, off the measured path.  (Federated runs
+                # spawn real daemons — pass --flight-recorder there via
+                # VTPU_FLIGHT_RECORDER instead.)
+                from volcano_tpu import obs as _obs
+
+                _obs.enable(topo.api, identity=f"loadgen-{label}")
             report = run_phase(
                 topo, rate, args.duration, args.tasks_per_job, args.cpu,
                 args.drain_timeout, label=label,
@@ -879,6 +914,8 @@ def run_loadgen(args) -> dict:
             )
             if hasattr(topo, "scheduler"):
                 report.update(_cycle_mix(topo))
+            if args.stage_breakdown and hasattr(topo, "scheduler"):
+                report["stage_breakdown"] = _stage_breakdown(topo)
             if args.apiserver_replicas > 0:
                 report["bus_ha"] = topo.bus_report()
                 if args.kill_apiserver_after > 0:
@@ -899,6 +936,10 @@ def run_loadgen(args) -> dict:
         finally:
             if killer is not None:
                 killer.cancel()
+            if args.stage_breakdown:
+                from volcano_tpu import obs as _obs
+
+                _obs.disable()  # idempotent; guards the error paths
             topo.close()
 
     out = {
@@ -1013,6 +1054,12 @@ def main(argv=None) -> int:
                    "the measured stream (federation chaos: survivors "
                    "must absorb its slices within one lease TTL and "
                    "every pod must still bind)")
+    p.add_argument("--stage-breakdown", action="store_true",
+                   help="enable the flight recorder during the run and "
+                   "attribute submit→bind latency to stages (cycle, "
+                   "kernel, commit flush, bus op, WAL fsync, quorum "
+                   "wait, bind landing) from collected spans — the "
+                   "per-stage report CI uploads next to the SLO JSON")
     p.add_argument("--quick", action="store_true",
                    help="CI smoke preset: small fleet, short stream")
     args = p.parse_args(argv)

@@ -6,6 +6,11 @@ granularity must stay under +5%.
 Snapshot capture is measured separately (snapshot_every=1, the worst
 case) — it's the sampled knob, not the always-on path.
 
+ISSUE 12 extends the gate to the flight-recorder SPAN path
+(volcano_tpu/obs): steady-state micro-cycle p99 with span recording on
+at default sampling must stay under +5%, and tracing OFF must cost
+zero (the null-span fast path) — both measured here.
+
 Emits one JSON line per mode plus a summary line with the delta, like
 the other bench/prof_*.py scripts.
 """
@@ -93,4 +98,38 @@ print(json.dumps({
     "within_budget": overhead_pct < 5.0,
     "tasks": 10_000,
     "nodes": 1_000,
+}))
+
+# ---- flight-recorder span path (ISSUE 12) ----
+
+from volcano_tpu import obs  # noqa: E402
+from volcano_tpu.client import APIServer  # noqa: E402
+
+# spans off: MUST be the disabled baseline (null-span fast path)
+spans_off_ms = cycle_ms()
+print(json.dumps({"metric": "span_cycle_latency", "mode": "disabled",
+                  "value": round(spans_off_ms, 3), "unit": "ms"}))
+
+sink = APIServer()
+exporter = obs.enable(sink, identity="prof-trace-overhead")
+try:
+    spans_on_ms = cycle_ms()
+finally:
+    obs.disable()
+print(json.dumps({"metric": "span_cycle_latency", "mode": "spans",
+                  "value": round(spans_on_ms, 3), "unit": "ms",
+                  "spans_exported": exporter.exported,
+                  "spans_dropped": exporter.dropped}))
+
+span_overhead_pct = (spans_on_ms - spans_off_ms) / spans_off_ms * 100.0
+span_off_delta_pct = (spans_off_ms - disabled_ms) / disabled_ms * 100.0
+print(json.dumps({
+    "metric": "span_overhead",
+    "value": round(span_overhead_pct, 2),
+    "unit": "%",
+    "spans_off_ms": round(spans_off_ms, 3),
+    "spans_on_ms": round(spans_on_ms, 3),
+    "off_vs_baseline_pct": round(span_off_delta_pct, 2),
+    "budget_pct": 5.0,
+    "within_budget": span_overhead_pct < 5.0,
 }))

@@ -73,6 +73,19 @@ def _leaked_nondaemon(before):
 
 
 @pytest.fixture(autouse=True)
+def _identity_label_guard():
+    """Daemons started inside a test stamp process-global identity
+    labels (metrics.set_identity) that would re-label every series a
+    LATER test renders — clear just the identity (never the counters,
+    which tests manage themselves) so cross-test isolation matches the
+    pre-identity-label world."""
+    yield
+    from volcano_tpu.metrics import metrics as _metrics
+
+    _metrics.registry.set_identity()
+
+
+@pytest.fixture(autouse=True)
 def _thread_leak_guard():
     before = set(threading.enumerate())
     yield

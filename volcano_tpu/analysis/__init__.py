@@ -23,6 +23,11 @@ machine-checked:
   ``bus/protocol.py`` has a serde round-trip exemplar, and every bus op
   is version-registered (ops past ``MIN_VERSION`` must carry the
   old-peer fallback).
+* :mod:`~volcano_tpu.analysis.metric_hygiene` — every Counter/Histogram
+  label with a non-literal value declares a statically bounded
+  vocabulary (docstring ``label ∈ {...}`` or ``# label-vocab:``), and
+  every catalog helper in ``metrics/metrics.py`` is observed by some
+  product module (no dead dashboard entries).
 
 Run ``python -m volcano_tpu.analysis`` (or ``vtctl lint``); CI fails on
 any finding not suppressed in the checked-in ``baseline.json``.
@@ -41,4 +46,4 @@ from volcano_tpu.analysis.core import (  # noqa: F401 — public surface
     run_passes,
 )
 
-PASSES = ("lock", "det", "jit", "serde")
+PASSES = ("lock", "det", "jit", "serde", "mtr")

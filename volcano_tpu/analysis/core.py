@@ -26,6 +26,7 @@ MARKERS = {
     "det": "det",
     "jit": "jit-ok",
     "serde": "serde-ok",
+    "mtr": "mtr",
 }
 
 
@@ -205,9 +206,10 @@ def run_passes(
     """Run the selected passes (default: all) over the tree at ``root``
     and return the raw findings, stably sorted."""
     from volcano_tpu.analysis import determinism, jit_safety, lock_discipline
-    from volcano_tpu.analysis import serde_drift
+    from volcano_tpu.analysis import metric_hygiene, serde_drift
 
-    selected = set(passes) if passes else {"lock", "det", "jit", "serde"}
+    selected = set(passes) if passes else {"lock", "det", "jit", "serde",
+                                           "mtr"}
     findings: List[Finding] = []
     if "lock" in selected:
         findings.extend(lock_discipline.run(root))
@@ -217,5 +219,7 @@ def run_passes(
         findings.extend(jit_safety.run(root))
     if "serde" in selected:
         findings.extend(serde_drift.run(root))
+    if "mtr" in selected:
+        findings.extend(metric_hygiene.run(root))
     findings.sort(key=lambda f: (f.file, f.line, f.code, f.symbol))
     return findings

@@ -406,6 +406,16 @@ class RemoteAPIServer:
             cycle = trace.current_cycle()
             if cycle >= 0 and "cycle" not in payload:
                 payload["cycle"] = cycle
+            # flight-recorder span context rides the same payload slot
+            # discipline (obs/spans.py): old servers ignore the key —
+            # no new op, no version bump.  None when the recorder is
+            # off or no span is open, so the default path stamps
+            # nothing.
+            from volcano_tpu import obs
+
+            span_ctx = obs.current_wire()
+            if span_ctx is not None and "span" not in payload:
+                payload["span"] = span_ctx
         start = time.perf_counter()
         if not self._connected.wait(timeout):
             metrics.observe_bus_request(method, time.perf_counter() - start,
@@ -932,9 +942,20 @@ class RemoteAPIServer:
             kind, operation = payload["kind"], payload["operation"]
             hooks = list(self._admission.get((kind, operation), []))
             try:
+                from volcano_tpu import obs
+
                 obj = protocol.decode_obj(payload["object"])
-                for hook in hooks:
-                    obj = hook(operation, obj) or obj
+                meta = getattr(obj, "metadata", None)
+                with obs.adopt(
+                    payload.get("span"), "admission:review", cat="admission",
+                    args={
+                        "kind": kind, "operation": operation,
+                        **({"pod": f"{meta.namespace}/{meta.name}"}
+                           if kind == "Pod" and meta is not None else {}),
+                    },
+                ):
+                    for hook in hooks:
+                        obj = hook(operation, obj) or obj
                 resp = {"allowed": True, "object": protocol.encode_obj(obj)}
             except AdmissionError as e:
                 resp = {"allowed": False, "message": str(e)}

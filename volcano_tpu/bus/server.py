@@ -559,7 +559,21 @@ class BusServer:
                 cycle=payload["cycle"], kind=payload.get("kind"),
             )
         try:
-            result = self._execute(conn, req_id, payload, op)
+            from volcano_tpu import obs
+
+            # server-side half of the cross-process span: parent is the
+            # REMOTE caller's span (payload["span"], stamped by
+            # bus/remote.py).  Ops without a context — or with the
+            # flight recorder off — cost one enabled() check.
+            if obs.enabled() and "span" in payload:
+                with obs.adopt(
+                    payload["span"], "bus:" + op, cat="bus",
+                    args={"kind": payload.get("kind")}
+                    if payload.get("kind") else None,
+                ):
+                    result = self._execute(conn, req_id, payload, op)
+            else:
+                result = self._execute(conn, req_id, payload, op)
             if result is not None:
                 conn.push(protocol.T_RESP, req_id, result)
             metrics.observe_bus_server_request(op, time.perf_counter() - start, "ok")
@@ -766,7 +780,13 @@ class BusServer:
             conns = list(self._admission.get((kind, operation), ()))
         if not conns:
             return obj
+        from volcano_tpu import obs
+
         data = protocol.encode_obj(obj)
+        # the review runs in the WEBHOOK daemon's process — forward the
+        # span context so its admission:review span parents into this
+        # request's trace (old webhook clients ignore the key)
+        span_ctx = obs.current_wire()
         for conn in conns:
             if conn.closed:
                 continue
@@ -775,9 +795,10 @@ class BusServer:
                 review_id = self._review_id
             waiter = {"event": threading.Event(), "result": None}
             conn.reviews[review_id] = waiter
-            if not conn.push(protocol.T_ADMIT_REQ, review_id, {
-                "kind": kind, "operation": operation, "object": data,
-            }):
+            review = {"kind": kind, "operation": operation, "object": data}
+            if span_ctx is not None:
+                review["span"] = span_ctx
+            if not conn.push(protocol.T_ADMIT_REQ, review_id, review):
                 continue
             if not waiter["event"].wait(self.admission_timeout):
                 conn.reviews.pop(review_id, None)

@@ -413,7 +413,21 @@ class PersistentAPIServer(APIServer):
                     demoted = self.read_only
         if last_seq:
             if replicator is not None:
-                committed = replicator.wait_commit(last_seq)
+                from volcano_tpu import obs
+
+                _q0 = time.perf_counter()
+                if obs.enabled() and obs.current() is not None:
+                    # quorum wait parks OUTSIDE the store lock (see
+                    # above) — the span shows replication, not fsync,
+                    # as the write's tail latency when followers lag
+                    with obs.span("repl:quorum_wait", cat="repl",
+                                  args={"seq": last_seq}):
+                        committed = replicator.wait_commit(last_seq)
+                else:
+                    committed = replicator.wait_commit(last_seq)
+                metrics.observe_repl_quorum_wait(
+                    time.perf_counter() - _q0
+                )
                 self.flush_committed(last_seq if committed
                                      else replicator.commit_seq())
                 if error is None and not committed:
@@ -612,6 +626,15 @@ class PersistentAPIServer(APIServer):
         self.last_fsync_ts = time.time()
         self.last_fsync_ms = round(dt * 1e3, 3)
         metrics.observe_wal_fsync(dt)
+        from volcano_tpu import obs
+
+        if obs.enabled() and obs.current() is not None:
+            # flight recorder: the durability cost lands in the traced
+            # request's waterfall.  Context-gated (and emission is a
+            # bounded ring append — obs/channel.py) so telemetry never
+            # extends this store-lock hold with I/O.
+            obs.complete("wal:fsync", dt, cat="wal",
+                         args={"bytes": len(payload)})
         self._wal_size += _REC_HEADER.size + len(payload)
         metrics.update_wal_size(self._wal_size)
 
@@ -764,6 +787,8 @@ class PersistentAPIServer(APIServer):
                 "snapshot_seq": self._snapshot_seq,
                 "last_fsync_ts": self.last_fsync_ts,
                 "last_fsync_ms": self.last_fsync_ms,
+                **({"metrics_address": self.metrics_address}
+                   if getattr(self, "metrics_address", "") else {}),
             }
 
     def close(self) -> None:

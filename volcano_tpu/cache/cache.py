@@ -1085,7 +1085,7 @@ class SchedulerCache(Cache):
                 if err is not None:
                     self._fail_bind_item(t, h, RuntimeError(err))
                 else:
-                    self._observe_bind_latency(t)
+                    self._observe_bind_latency(t, h)
             return
         for task, hostname in ok:
             try:
@@ -1094,7 +1094,7 @@ class SchedulerCache(Cache):
             except Exception as e:  # noqa: BLE001
                 self._fail_bind_item(task, hostname, e)
             else:
-                self._observe_bind_latency(task)
+                self._observe_bind_latency(task, hostname)
                 # cache.go:600-610 — the Scheduled audit event
                 self._record_event(
                     task, "Normal", "Scheduled",
@@ -1103,28 +1103,51 @@ class SchedulerCache(Cache):
                 )
 
     @staticmethod
-    def _observe_bind_latency(task: TaskInfo) -> None:
+    def _observe_bind_latency(task: TaskInfo, hostname: str = "") -> None:
         """volcano_submit_to_bind_latency_milliseconds: store creation
         timestamp → bind effect landed — the sustained-load SLO number,
         recorded here so the synchronous and pipelined paths share the
         one landing site.  Synthetic fixtures carry small ordinal
         timestamps, not epochs — only a plausible wall-clock stamp is
         observed (everything else would land in +Inf and poison the
-        percentiles)."""
+        percentiles).  The flight-recorder ``bind:landed`` span rides
+        the same site: one landing, every sink."""
         import time as _time
 
+        from volcano_tpu.metrics import metrics
+
+        metrics.update_pod_schedule_status("successes")
         pod = task.pod
         ts = pod.metadata.creation_timestamp if pod is not None else 0
         if ts and ts > 1e9:  # epoch seconds, not an ordinal fixture stamp
-            from volcano_tpu.metrics import metrics
-
             metrics.observe_submit_to_bind(max(_time.time() - ts, 0.0))
+        from volcano_tpu import obs
+
+        if obs.enabled():
+            args = {"pod": f"{task.namespace}/{task.name}"}
+            if hostname:
+                args["node"] = hostname
+            gang = ""
+            if pod is not None:
+                from volcano_tpu.apis import scheduling as _sched
+
+                gang = pod.metadata.annotations.get(
+                    _sched.GROUP_NAME_ANNOTATION_KEY, ""
+                )
+            if gang:
+                args["gang"] = f"{task.namespace}/{gang}"
+            obs.complete(
+                "bind:landed", 0.0, cat="bind",
+                trace_id=obs.trace_id_for_pod(task.namespace, task.name),
+                args=args,
+            )
 
     def _fail_bind_item(self, task, hostname, e) -> None:
         from volcano_tpu.metrics import metrics
 
         log.error("bind of %s/%s failed: %s", task.namespace, task.name, e)
         metrics.register_commit_failure("bind")
+        metrics.update_pod_schedule_status("errors")
         self._record_event(
             task, "Warning", "FailedScheduling",
             f"failed to bind to {hostname}: {e}",

@@ -64,8 +64,10 @@ class CommitPlane:
         self.cache = cache
         self.max_coalesce = max_coalesce
         self._cv = threading.Condition()
-        #: ("bind", task, hostname, doomed) | ("evict", task, reason,
-        #: doomed) | ("status", payload, doomed)
+        #: ("bind", task, hostname, doomed, meta) | ("evict", task,
+        #: reason, doomed, meta) | ("status", payload, None, doomed,
+        #: meta) — ``meta`` is the flight-recorder handoff (submitting
+        #: span context + enqueue stamp), None with the recorder off
         self._items: deque = deque()  # guarded-by: self._cv
         self._inflight = 0  # guarded-by: self._cv
         self._stopped = False  # guarded-by: self._cv
@@ -111,25 +113,46 @@ class CommitPlane:
             doom = doom or RuntimeError("fault-injected bind failure")
         return doom
 
+    @staticmethod
+    def _obs_meta():
+        """Flight-recorder handoff captured at SUBMIT time on the
+        scheduling thread: (trace_id, span_id, enqueue_perf) of the
+        submitting cycle's span, so the worker-side flush span parents
+        into the cycle that queued the work and the queue wait is
+        measurable.  None with the recorder off — zero per-item cost."""
+        from volcano_tpu import obs
+
+        if not obs.enabled():
+            return None
+        ctx = obs.current()
+        if ctx is None:
+            return ("", "", time.perf_counter())
+        return (ctx[0], ctx[1], time.perf_counter())
+
     def submit_binds(self, pairs: List[Tuple[object, str]]) -> None:
+        meta = self._obs_meta()
         with self._cv:
             for task, hostname in pairs:
                 self._items.append(
-                    ("bind", task, hostname, self._doom("cache.bind_fail"))
+                    ("bind", task, hostname,
+                     self._doom("cache.bind_fail"), meta)
                 )
             self._cv.notify_all()
             self._update_depth()
 
     def submit_evicts(self, pairs: List[Tuple[object, str]]) -> None:
+        meta = self._obs_meta()
         with self._cv:
             for task, reason in pairs:
-                self._items.append(("evict", task, reason, self._doom()))
+                self._items.append(("evict", task, reason, self._doom(),
+                                    meta))
             self._cv.notify_all()
             self._update_depth()
 
     def submit_status(self, payload: dict) -> None:
         with self._cv:
-            self._items.append(("status", payload, None, self._doom()))
+            self._items.append(("status", payload, None, self._doom(),
+                                self._obs_meta()))
             self._cv.notify_all()
             self._update_depth()
 
@@ -191,26 +214,51 @@ class CommitPlane:
         # coalesces into one frame.  (inject=False on binds: the fault
         # points were already evaluated at submit time — the worker
         # must not draw a second decision.)
-        i = 0
-        while i < len(batch):
-            kind = batch[i][0]
-            j = i
-            while j < len(batch) and batch[j][0] == kind:
-                j += 1
-            run = batch[i:j]
-            i = j
-            if kind == "bind":
-                self.cache._run_bind_items(
-                    [(t, h, doom) for _k, t, h, doom in run], inject=False
-                )
-            elif kind == "evict":
-                self.cache._run_evict_items(
-                    [(t, r, doom) for _k, t, r, doom in run]
-                )
-            else:
-                self.cache._run_status_items(
-                    [(p, doom) for _k, p, _x, doom in run]
-                )
+        with self._flush_span(batch):
+            i = 0
+            while i < len(batch):
+                kind = batch[i][0]
+                j = i
+                while j < len(batch) and batch[j][0] == kind:
+                    j += 1
+                run = batch[i:j]
+                i = j
+                if kind == "bind":
+                    self.cache._run_bind_items(
+                        [(t, h, doom) for _k, t, h, doom, _m in run],
+                        inject=False,
+                    )
+                elif kind == "evict":
+                    self.cache._run_evict_items(
+                        [(t, r, doom) for _k, t, r, doom, _m in run]
+                    )
+                else:
+                    self.cache._run_status_items(
+                        [(p, doom) for _k, p, _x, doom, _m in run]
+                    )
+
+    @staticmethod
+    def _flush_span(batch):
+        """The worker-side ``commit:flush`` span: parented to the
+        submitting cycle's span (captured at submit — workers have no
+        ambient context of their own), carrying the batch size and the
+        oldest item's queue wait.  Null span with the recorder off."""
+        from volcano_tpu import obs
+
+        if not obs.enabled():
+            return obs.span("commit:flush")  # the shared null span
+        now = time.perf_counter()
+        metas = [it[4] for it in batch if it[4] is not None]
+        args = {"items": len(batch)}
+        if metas:
+            args["queue_wait_ms"] = round(
+                max(now - m[2] for m in metas) * 1e3, 3
+            )
+        parent = next((m for m in metas if m[1]), None)
+        if parent is not None:
+            return obs.adopt({"t": parent[0], "s": parent[1]},
+                             "commit:flush", cat="commit", args=args)
+        return obs.span("commit:flush", cat="commit", args=args)
 
     # ---- the commit barrier ----
 

@@ -641,13 +641,219 @@ def _trace_diff(vc: VolcanoClient, args, out) -> int:
 
 
 def _trace_export(vc: VolcanoClient, args, out) -> int:
-    from volcano_tpu.trace.export import export_chrome_trace
+    from volcano_tpu.trace.export import (
+        export_chrome_trace,
+        export_merged_chrome_trace,
+    )
 
-    text = export_chrome_trace(args.dir, cycle=args.cycle, path=args.out or None)
+    dirs = list(args.dir or [])
+    if len(dirs) > 1:
+        # per-process journals merge under distinct pid/tid rows on a
+        # shared wall-clock origin — the multiproc drills' combined view
+        text = export_merged_chrome_trace(
+            dirs, cycle=args.cycle, path=args.out or None
+        )
+    else:
+        text = export_chrome_trace(
+            dirs[0], cycle=args.cycle, path=args.out or None
+        )
     if args.out:
         print(f"wrote Chrome trace to {args.out}", file=out)
     else:
         print(text, file=out)
+    return 0
+
+
+# ---- flight recorder (volcano_tpu/obs): the cross-process waterfall ----
+
+def _trace_identity(vc: VolcanoClient, args, out, gang: bool) -> int:
+    """Shared body of ``vtctl trace pod`` / ``vtctl trace gang``:
+    collect the durably-held telemetry segments from the bus, select
+    the identity's trace (matched spans + ancestor closure + the
+    cycles' process-scope sub-spans) and render the submit→bind
+    waterfall; ``--chrome`` additionally writes the merged
+    multi-process trace_event JSON with real pid/tid rows.  Reads only
+    the API surface — identical over in-process and ``--bus``."""
+    import json as _json
+
+    from volcano_tpu import obs
+
+    spans = obs.collect_spans(vc.api)
+    if gang:
+        idents = [(args.namespace, args.name)]
+    else:
+        # a pod's waterfall unions the pod, its PodGroup, and its
+        # owning Job (the controller's status-writeback trace)
+        idents = obs.related_identities(vc.api, args.namespace, args.name)
+    trace = obs.select_union(spans, idents)
+    kind = "gang" if gang else "pod"
+    print(f"Flight recorder — {kind} {args.namespace}/{args.name} "
+          f"(trace {obs.trace_id_for(args.namespace, args.name)})",
+          file=out)
+    obs.render_waterfall(trace, out)
+    if getattr(args, "chrome", ""):
+        with open(args.chrome, "w") as f:
+            f.write(_json.dumps(obs.chrome_export(trace), indent=1))
+        print(f"wrote merged Chrome trace to {args.chrome}", file=out)
+    return 0 if trace else 1
+
+
+def _trace_pod(vc: VolcanoClient, args, out) -> int:
+    return _trace_identity(vc, args, out, gang=False)
+
+
+def _trace_gang(vc: VolcanoClient, args, out) -> int:
+    return _trace_identity(vc, args, out, gang=True)
+
+
+# ---- top (federated /metrics aggregation) ----
+
+#: the write-path ops whose latency the COMMIT column aggregates
+_COMMIT_OPS = ("create", "commit_batch", "cas_bind", "txn_commit")
+
+
+def _top_targets(vc: VolcanoClient, args) -> Dict[str, str]:
+    """member label → host:port /metrics address.  Discovery is
+    configuration-free: scheduler members advertise ``metricsAddr`` on
+    the shard lease map's stats blob, apiserver replicas advertise
+    ``metrics_address`` on ``bus_status`` (every endpoint in the
+    ``--bus`` list is asked, since followers answer locally).
+    ``--metrics a,b`` adds explicit extra targets."""
+    from volcano_tpu.federation import read_shard_map
+
+    targets: Dict[str, str] = {}
+    try:
+        rec = read_shard_map(vc.api)
+    except ApiError:
+        rec = None
+    if rec:
+        for ident in sorted(rec.get("stats") or {}):
+            addr = (rec["stats"][ident] or {}).get("metricsAddr")
+            if addr:
+                targets[ident] = addr
+    bus = getattr(args, "bus", "") or ""
+    if bus:
+        from volcano_tpu.bus import BusError, connect_bus
+
+        for i, url in enumerate(u.strip() for u in bus.split(",")):
+            if not url:
+                continue
+            try:
+                remote = connect_bus(url, wait=2.0)
+                try:
+                    st = remote.bus_status()
+                finally:
+                    remote.close()
+            except (BusError, ApiError):
+                continue
+            addr = st.get("metrics_address")
+            if addr:
+                targets[f"apiserver-{i} [{st.get('role', '?')}]"] = addr
+    else:
+        st = vc.api.bus_status() if hasattr(vc.api, "bus_status") else {}
+        addr = st.get("metrics_address")
+        if addr:
+            targets[f"apiserver [{st.get('role', '?')}]"] = addr
+    for addr in (getattr(args, "metrics", "") or "").split(","):
+        addr = addr.strip()
+        if addr:
+            targets.setdefault(addr, addr)
+    return targets
+
+
+def _top(vc: VolcanoClient, args, out) -> int:
+    """Aggregate /metrics across the whole membership: one row per
+    member (scheduler shards from the lease map, apiserver replicas
+    from the endpoint list) plus a cluster-wide TOTAL row.  With
+    ``--interval S`` two scrapes bound a window and the counters/
+    histograms become rates and windowed percentiles; otherwise the
+    columns are process-lifetime cumulative."""
+    import time as _time
+
+    from volcano_tpu.metrics import scrape as _scrape
+
+    targets = _top_targets(vc, args)
+    if not targets:
+        print("no scrape targets discovered — need a running federation "
+              "(shard map with metricsAddr), a --bus endpoint list, or "
+              "explicit --metrics host:port", file=out)
+        return 1
+
+    def scrape_all() -> Dict[str, object]:
+        scrapes = {}
+        for label, addr in targets.items():
+            try:
+                scrapes[label] = _scrape.parse_metrics(
+                    _scrape.fetch_metrics(addr)
+                )
+            except OSError as e:
+                print(f"  scrape of {label} ({addr}) failed: {e}", file=out)
+        return scrapes
+
+    first = scrape_all()
+    interval = getattr(args, "interval", 0.0) or 0.0
+    if interval > 0:
+        _time.sleep(interval)
+        second = scrape_all()
+        scrapes = {
+            label: _scrape.delta(second[label], first[label])
+            for label in second if label in first
+        }
+        window = f"{interval:g}s window"
+    else:
+        scrapes = first
+        window = "cumulative"
+    if not scrapes:
+        print("every scrape failed", file=out)
+        return 1
+
+    def row(label: str, s) -> str:
+        q = _scrape.histogram_quantile
+        cycles = s.histogram("volcano_e2e_scheduling_latency_milliseconds")
+        commit = _scrape.merge_histograms([h for h in (
+            *(s.histogram("volcano_bus_request_latency_milliseconds",
+                          method=op) for op in _COMMIT_OPS),
+            *(s.histogram("volcano_bus_server_request_latency_milliseconds",
+                          op=op) for op in _COMMIT_OPS),
+        ) if h])
+        return (
+            f"  {label:<30}"
+            f"{int((cycles or {}).get('count', 0)):<8}"
+            f"{int(s.value('volcano_pod_schedule_successes')):<8}"
+            f"{q(s.histogram('volcano_submit_to_bind_latency_milliseconds'), 0.99):<9.1f}"
+            f"{q(commit, 0.99):<11.1f}"
+            f"{q(s.histogram('volcano_wal_fsync_latency_milliseconds'), 0.99):<10.1f}"
+            f"{q(s.histogram('volcano_repl_quorum_wait_milliseconds'), 0.99):<11.1f}"
+            f"{int(s.value('volcano_telemetry_dropped_total')):<8}"
+        )
+
+    print(f"Cluster metrics ({window}; {len(scrapes)} member(s)):",
+          file=out)
+    print(
+        f"  {'MEMBER':<30}{'CYCLES':<8}{'BINDS':<8}{'S2B-99':<9}"
+        f"{'COMMIT-99':<11}{'FSYNC-99':<10}{'QUORUM-99':<11}{'DROPPED':<8}",
+        file=out,
+    )
+    for label in sorted(scrapes):
+        print(row(label, scrapes[label]), file=out)
+    # cluster-wide: histograms merge pointwise, counters sum
+    total = _scrape.Scrape()
+    for s in scrapes.values():
+        for key, v in s.series.items():
+            name = key[0]
+            if name.endswith("_total") or name.endswith("_counts") or (
+                "pod_schedule" in name
+            ):
+                total.series[key] = total.series.get(key, 0.0) + v
+        for key, h in s.histograms.items():
+            cur = total.histograms.get(key)
+            total.histograms[key] = (
+                _scrape.merge_histograms([cur, h]) if cur else h
+            )
+    print(row("CLUSTER", total), file=out)
+    if interval > 0:
+        binds = int(total.value("volcano_pod_schedule_successes"))
+        print(f"  cluster bind rate: {binds / interval:.1f}/s", file=out)
     return 0
 
 
@@ -758,9 +964,50 @@ def build_parser() -> argparse.ArgumentParser:
             tp.add_argument("--limit", type=int, default=20)
 
     te = trace_p.add_parser("export")
-    te.add_argument("--dir", "-d", required=True)
+    te.add_argument(
+        "--dir", "-d", required=True, action="append",
+        help="journal directory; repeat to merge several per-process "
+        "journals into one Chrome trace with distinct pid rows on a "
+        "shared clock origin",
+    )
     te.add_argument("--cycle", type=int, default=None)
     te.add_argument("--out", "-o", default="", help="output file (default stdout)")
+
+    for name in ("pod", "gang"):
+        tp = trace_p.add_parser(
+            name,
+            description="flight recorder: render the cross-process "
+            "submit→bind waterfall for one "
+            + ("gang (PodGroup identity)" if name == "gang"
+               else "pod identity")
+            + " from the telemetry segments on the bus",
+        )
+        tp.add_argument("--name", "-N", required=True)
+        tp.add_argument("--namespace", "-n", default="default")
+        tp.add_argument(
+            "--chrome", default="",
+            help="also write the merged multi-process Chrome "
+            "trace_event JSON here (real pid/tid rows)",
+        )
+
+    top = sub.add_parser(
+        "top",
+        description="aggregate /metrics across the whole membership "
+        "(scheduler shards discovered from the shard lease map, "
+        "apiserver replicas from the --bus endpoint list): per-member "
+        "and cluster-wide rates, commit/fsync/quorum latency columns",
+    )
+    top.set_defaults(cmd=None)
+    top.add_argument(
+        "--metrics", default="",
+        help="extra host:port /metrics targets, comma-separated "
+        "(for daemons outside the federation/replica discovery)",
+    )
+    top.add_argument(
+        "--interval", type=float, default=0.0,
+        help="seconds between two scrapes: columns become windowed "
+        "rates/percentiles instead of process-lifetime cumulative",
+    )
 
     faults_p = sub.add_parser(
         "faults",
@@ -801,12 +1048,15 @@ _HANDLERS = {
     ("describe", "job"): _describe_job,
     ("describe", "podgroup"): _describe_podgroup,
     ("shards", None): _shards,
+    ("top", None): _top,
     ("bus", "status"): _bus_status,
     ("faults", "validate"): _faults_validate,
     ("trace", "record"): _trace_record,
     ("trace", "replay"): _trace_replay,
     ("trace", "diff"): _trace_diff,
     ("trace", "export"): _trace_export,
+    ("trace", "pod"): _trace_pod,
+    ("trace", "gang"): _trace_gang,
 }
 
 

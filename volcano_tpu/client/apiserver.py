@@ -95,8 +95,26 @@ class APIServer:
 
     def _run_admission(self, kind: str, operation: str, obj):
         # requires-lock: self._lock
-        for hook in self._admission.get((kind, operation), []):
-            obj = hook(operation, obj) or obj
+        hooks = self._admission.get((kind, operation), [])
+        if not hooks:
+            return obj
+        from volcano_tpu import obs
+
+        if not obs.enabled():
+            # recorder off: no trace-id hash / args dict built while
+            # holding the store lock (the zero-cost-off budget)
+            for hook in hooks:
+                obj = hook(operation, obj) or obj
+            return obj
+        meta = getattr(obj, "metadata", None)
+        with obs.span(
+            "admission:review", cat="admission",
+            trace_id=obs.trace_id_for(meta.namespace or "", meta.name or "")
+            if meta is not None else None,
+            args={"kind": kind, "operation": operation},
+        ):
+            for hook in hooks:
+                obj = hook(operation, obj) or obj
         return obj
 
     def bus_status(self) -> dict:
@@ -106,7 +124,13 @@ class APIServer:
         WAL/snapshot/replication fields, and ``bus.RemoteAPIServer``
         fetches the same payload over the wire — one renderer, every
         backend."""
-        return {"role": "standalone", "persistent": False}
+        out = {"role": "standalone", "persistent": False}
+        addr = getattr(self, "metrics_address", "")
+        if addr:
+            # the serving daemon's /metrics address — how `vtctl top`
+            # discovers scrape targets from the --bus endpoint list
+            out["metrics_address"] = addr
+        return out
 
     # ---- admission registration (the webhook configuration) ----
 

@@ -31,19 +31,47 @@ class AdmissionDaemon:
         listen_host: str = "127.0.0.1",
         listen_port: int = 0,
         debug_enabled: bool = False,
+        flight_recorder: bool = None,
     ):
         self.api = api
         register_webhooks(api, gate_pods=gate_pods)
         self.serving = ServingServer(
             host=listen_host, port=listen_port, debug_enabled=debug_enabled
         )
+        if flight_recorder is None:
+            import os
+
+            flight_recorder = os.environ.get(
+                "VTPU_FLIGHT_RECORDER", ""
+            ) not in ("", "0")
+        self.flight_recorder = flight_recorder
+        self._obs_exporter = None
 
     def start(self) -> "AdmissionDaemon":
+        from volcano_tpu.metrics import metrics
+
+        metrics.set_identity(daemon="admission", role="admission")
+        if self.flight_recorder:
+            import os
+
+            from volcano_tpu import obs
+
+            self._obs_exporter = obs.enable(
+                self.api, identity=f"admission-{os.getpid()}"
+            )
         self.serving.start()
         log.info("admission daemon serving on :%d", self.serving.port)
         return self
 
     def stop(self) -> None:
+        if self._obs_exporter is not None:
+            from volcano_tpu import obs
+
+            if obs.get_exporter() is self._obs_exporter:
+                obs.disable()
+            else:
+                self._obs_exporter.stop()
+            self._obs_exporter = None
         self.serving.stop()
 
 
@@ -59,6 +87,7 @@ def main(argv=None) -> int:
         listen_host=args.listen_host,
         listen_port=args.listen_port,
         debug_enabled=args.enable_debug_stacks,
+        flight_recorder=True if args.flight_recorder else None,
     )
     daemon.start()
     try:

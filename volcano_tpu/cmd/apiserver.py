@@ -65,7 +65,15 @@ class ApiServerDaemon:
         replicas: Optional[List[str]] = None,
         replica_index: int = 0,
         repl_lease_ttl: float = 2.0,
+        flight_recorder: Optional[bool] = None,
     ):
+        if flight_recorder is None:
+            flight_recorder = os.environ.get(
+                "VTPU_FLIGHT_RECORDER", ""
+            ) not in ("", "0")
+        self.flight_recorder = flight_recorder
+        self._obs_exporter = None
+        self.replica_index = replica_index
         self.replica = None
         if api is not None:
             self.api = api
@@ -91,6 +99,9 @@ class ApiServerDaemon:
                 )
             from volcano_tpu.bus.replication import ReplicaManager
 
+            # the identity `role` label follows the replication role in
+            # BOTH directions via metrics.update_repl_role — no daemon
+            # hook needed for promotion OR demotion
             self.replica = ReplicaManager(
                 self.api, replicas, replica_index,
                 lease_ttl=repl_lease_ttl,
@@ -148,10 +159,28 @@ class ApiServerDaemon:
                 time.sleep(min(0.5 * attempt, 5.0))
 
     def start(self) -> "ApiServerDaemon":
+        from volcano_tpu.metrics import metrics
+
+        metrics.set_identity(
+            daemon="apiserver",
+            replica_index=str(self.replica_index),
+            role="standalone" if self.replica is None else "follower",
+        )
         if self.seed_nodes > 0 and self.replica is None:
             self._seed_if_configured()
         self.bus.start()
         self.serving.start()
+        # advertised on bus_status so `vtctl top` can discover every
+        # replica's /metrics by dialing the --bus endpoint list
+        self.api.metrics_address = (
+            f"{self.serving.host}:{self.serving.port}"
+        )
+        if self.flight_recorder:
+            from volcano_tpu import obs
+
+            self._obs_exporter = obs.enable(
+                self.api, identity=f"apiserver-{self.replica_index}"
+            )
         if self.replica is not None:
             self.replica.start()
         log.info(
@@ -228,6 +257,12 @@ def main(argv=None) -> int:
         "repl.* points fire server-side here; same grammar as "
         "VTPU_FAULTS)",
     )
+    parser.add_argument(
+        "--flight-recorder", action="store_true",
+        help="record bus-op / WAL-fsync / quorum-wait spans for traced "
+        "requests and export them as telemetry segments "
+        "(volcano_tpu/obs; also VTPU_FLIGHT_RECORDER=1)",
+    )
     args = parser.parse_args(argv)
     from volcano_tpu.cmd.daemon import apply_faults
 
@@ -249,6 +284,7 @@ def main(argv=None) -> int:
         replicas=replicas,
         replica_index=args.replica_index,
         repl_lease_ttl=args.repl_lease_ttl,
+        flight_recorder=True if args.flight_recorder else None,
     ).start()
     try:
         threading.Event().wait()

@@ -60,6 +60,7 @@ def main(argv=None) -> int:
             leader_elect=args.leader_elect,
             identity=args.leader_elect_id,
             debug_enabled=args.enable_debug_stacks,
+            flight_recorder=True if args.flight_recorder else None,
         )
     )
 

@@ -6,6 +6,7 @@ semantics, liveness) lives here once.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
@@ -41,10 +42,27 @@ class BaseDaemon:
         retry_period: float = 0.2,
         debug_enabled: bool = False,
         explain_source=None,
+        flight_recorder: Optional[bool] = None,
     ):
         self.api = api
         self.period = period
         self.identity = identity or f"{self.NAME}-{uuid.uuid4().hex[:8]}"
+        #: cluster-wide flight recorder (volcano_tpu/obs): span batches
+        #: export to the bus as telemetry segments.  None = follow
+        #: VTPU_FLIGHT_RECORDER (so local_up/chaos harnesses flip every
+        #: daemon with one env var)
+        if flight_recorder is None:
+            flight_recorder = os.environ.get(
+                "VTPU_FLIGHT_RECORDER", ""
+            ) not in ("", "0")
+        self.flight_recorder = flight_recorder
+        self._obs_exporter = None
+        #: uniform identity labels merged into every /metrics series
+        #: (vtctl top's federation contract); subclasses refine
+        self.identity_labels = {
+            "daemon": self.NAME.replace("vtpu-", ""),
+            "role": self.NAME.replace("vtpu-", ""),
+        }
         self.serving = ServingServer(
             host=listen_host, port=listen_port, health_check=self.healthy,
             debug_enabled=debug_enabled, explain_source=explain_source,
@@ -92,6 +110,13 @@ class BaseDaemon:
         return self._thread is None or self._thread.is_alive()
 
     def start(self):
+        from volcano_tpu.metrics import metrics
+
+        metrics.set_identity(**self.identity_labels)
+        if self.flight_recorder:
+            from volcano_tpu import obs
+
+            self._obs_exporter = obs.enable(self.api, identity=self.identity)
         self.serving.start()
         self._on_start()
         if self.elector is not None:
@@ -111,6 +136,14 @@ class BaseDaemon:
             self._thread.join(timeout=10)
         if self.elector is not None:
             self.elector.stop(release=not crash)
+        if self._obs_exporter is not None:
+            from volcano_tpu import obs
+
+            if obs.get_exporter() is self._obs_exporter:
+                obs.disable()  # final flush rides the exporter stop
+            else:
+                self._obs_exporter.stop()
+            self._obs_exporter = None
         self.serving.stop()
 
 

@@ -157,6 +157,7 @@ def multiproc_up(nodes: int = 3, node_cpu: str = "8", node_mem: str = "16Gi",
                  apiserver_replicas: int = 1,
                  apiserver_data_dir: str = "",
                  repl_lease_ttl: float = 2.0,
+                 flight_recorder: bool = False,
                  ) -> Tuple[object, List[subprocess.Popen]]:
     """The reference's deployment topology as real OS processes:
     vtpu-apiserver + vtpu-admission + vtpu-controllers + vtpu-scheduler
@@ -175,6 +176,9 @@ def multiproc_up(nodes: int = 3, node_cpu: str = "8", node_mem: str = "16Gi",
     if bus_port == 0:
         bus_port = _free_port(listen_host)
     procs: List[subprocess.Popen] = []
+    #: appended to EVERY daemon so the whole topology records into one
+    #: flight-recorder namespace (`vtctl trace pod` spans ≥3 processes)
+    fr_flags = ["--flight-recorder"] if flight_recorder else []
 
     if apiserver_replicas > 1:
         ports = [bus_port] + [
@@ -198,6 +202,7 @@ def multiproc_up(nodes: int = 3, node_cpu: str = "8", node_mem: str = "16Gi",
                 # read-only), so every replica carries the flag
                 "--seed-nodes", str(nodes),
                 "--seed-node-cpu", node_cpu, "--seed-node-mem", node_mem,
+                *fr_flags,
             ))
     else:
         bus_url = f"tcp://{listen_host}:{bus_port}"
@@ -207,6 +212,7 @@ def multiproc_up(nodes: int = 3, node_cpu: str = "8", node_mem: str = "16Gi",
         ]
         if apiserver_data_dir:
             apiserver_flags += ["--data-dir", apiserver_data_dir]
+        apiserver_flags += fr_flags
         procs.append(_spawn("volcano_tpu.cmd.apiserver", *apiserver_flags))
     api = None
     try:
@@ -214,18 +220,21 @@ def multiproc_up(nodes: int = 3, node_cpu: str = "8", node_mem: str = "16Gi",
         # up; the except below reaps it
         api = connect_bus(bus_url, wait=60.0)
 
-        admission_flags = ["--bus", bus_url, "--listen-port", "0"]
+        admission_flags = ["--bus", bus_url, "--listen-port", "0",
+                           *fr_flags]
         if gate_pods:
             admission_flags.append("--gate-pods")
         procs.append(_spawn("volcano_tpu.cmd.admission", *admission_flags))
         procs.append(_spawn(
             "volcano_tpu.cmd.controllers",
             "--bus", bus_url, "--listen-port", "0", "--period", "0.1",
+            *fr_flags,
         ))
 
         scheduler_flags = [
             "--bus", bus_url, "--listen-port", "0",
             "--schedule-period", str(schedule_period),
+            *fr_flags,
         ]
         if micro_cycles:
             scheduler_flags.append("--micro-cycles")
@@ -358,6 +367,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "the periodic full cycles")
     parser.add_argument("--scheduler-conf", default="",
                         help="scheduler policy YAML, hot-reloaded per cycle")
+    parser.add_argument("--flight-recorder", action="store_true",
+                        help="enable the cluster-wide flight recorder "
+                        "on every spawned daemon (vtctl trace pod/gang "
+                        "renders the cross-process waterfall)")
     return parser
 
 
@@ -392,6 +405,7 @@ def main(argv=None) -> int:
             apiserver_replicas=args.apiserver_replicas,
             apiserver_data_dir=args.apiserver_data_dir,
             repl_lease_ttl=args.repl_lease_ttl,
+            flight_recorder=args.flight_recorder,
         )
         print(f"multi-process control plane up: bus {api.address}, "
               f"{len(procs)} daemons "

@@ -62,6 +62,7 @@ class SchedulerDaemon(BaseDaemon):
         )
         self.federation = None
         if shards >= 1:
+            self.identity_labels["shard"] = shard_identity or self.identity
             # sharded federation: the shard-assignment leases replace
             # the leader-elected standby pattern (each member is active
             # over its own slice), so --leader-elect is ignored here
@@ -108,6 +109,11 @@ class SchedulerDaemon(BaseDaemon):
 
     def _on_start(self) -> None:
         if self.federation is not None:
+            # published on the lease-map stats blob so `vtctl top`
+            # discovers this member's /metrics without configuration
+            self.federation.metrics_addr = (
+                f"{self.serving.host}:{self.serving.port}"
+            )
             self.federation.start()  # cache.run() + the lease loop
         else:
             self.cache.run()
@@ -153,6 +159,13 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
         "'seed=42;bus.disconnect=0.05;compute.crash=0.1:count=2' "
         "(volcano_tpu.faults; same grammar as VTPU_FAULTS — chaos "
         "testing only, never set in production)",
+    )
+    parser.add_argument(
+        "--flight-recorder", action="store_true",
+        help="cluster-wide flight recorder (volcano_tpu/obs): record "
+        "cross-process spans and export them to the bus as telemetry "
+        "segments for `vtctl trace pod/gang` (drop-not-block; also "
+        "VTPU_FLIGHT_RECORDER=1; sampling via VTPU_TELEMETRY_SAMPLE)",
     )
 
 
@@ -315,6 +328,7 @@ def main(argv=None) -> int:
             leader_elect=args.leader_elect,
             identity=args.leader_elect_id,
             debug_enabled=args.enable_debug_stacks,
+            flight_recorder=True if args.flight_recorder else None,
         )
     )
 

@@ -390,13 +390,30 @@ class JobController:
 
     # ---- sync/kill (job_controller_actions.go) ----
 
+    def _write_status(self, job: batch.Job) -> batch.Job:
+        """The one status-writeback site all sync/kill paths share —
+        wrapped in a flight-recorder ``controller:status`` span keyed
+        to the job identity, so the controller's leg shows up in the
+        cross-process waterfall (``vtctl trace pod/gang``)."""
+        from volcano_tpu import obs
+
+        ns = job.metadata.namespace
+        name = job.metadata.name
+        with obs.span(
+            "controller:status", cat="controller",
+            trace_id=obs.trace_id_for(ns, name),
+            args={"job": f"{ns}/{name}",
+                  "phase": job.status.state.phase},
+        ):
+            return self.vc.update_job_status(job)
+
     def _init_job_status(self, job: batch.Job) -> batch.Job:
         """actions.go initJobStatus."""
         if job.status.state.phase:
             return job
         job.status.state.phase = batch.JOB_PENDING
         job.status.min_available = job.spec.min_available
-        updated = self.vc.update_job_status(job)
+        updated = self._write_status(job)
         self.cache.update(updated)
         return updated
 
@@ -563,7 +580,7 @@ class JobController:
 
             if update_status(job.status):
                 job.status.state.last_transition_time = _time.time()
-        updated = self.vc.update_job_status(job)
+        updated = self._write_status(job)
         self.cache.update(updated)
 
     def kill_job(self, job_info: JobInfo, pod_retain_phases: Set[str], update_status) -> None:
@@ -607,7 +624,7 @@ class JobController:
 
             if update_status(job.status):
                 job.status.state.last_transition_time = _time.time()
-        updated = self.vc.update_job_status(job)
+        updated = self._write_status(job)
         self.cache.update(updated)
 
         # Delete PodGroup (actions.go:128-135).

@@ -225,7 +225,39 @@ class GangBroker:
     # ---- assembly ----
 
     def _assemble_one(self, entry: dict, rec: Optional[dict]) -> bool:
-        from volcano_tpu import faults
+        from volcano_tpu import obs
+
+        if not obs.enabled():
+            # recorder off: skip the member-annotation scan entirely
+            return self._assemble_one_inner(entry, rec)
+        gang = self._gang_ident(entry)
+        with obs.span(
+            "gang:assemble", cat="federation",
+            trace_id=(obs.trace_id_for_gang(*gang) if gang else None),
+            args={"gang": f"{gang[0]}/{gang[1]}"} if gang else None,
+        ):
+            return self._assemble_one_inner(entry, rec)
+
+    @staticmethod
+    def _gang_ident(entry: dict):
+        """(namespace, podgroup-name) for the flight-recorder trace id,
+        from the members' group annotation — the same identity ``vtctl
+        trace gang`` derives its trace id from."""
+        from volcano_tpu.apis import scheduling as _sched
+
+        for task in entry.get("tasks", ()):
+            pod = getattr(task, "pod", None)
+            if pod is None:
+                continue
+            name = pod.metadata.annotations.get(
+                _sched.GROUP_NAME_ANNOTATION_KEY, ""
+            )
+            if name:
+                return (task.namespace, name)
+        return None
+
+    def _assemble_one_inner(self, entry: dict, rec: Optional[dict]) -> bool:
+        from volcano_tpu import faults, obs
 
         jid = entry["job_id"]
         mm = entry["min_member"]
@@ -242,14 +274,16 @@ class GangBroker:
             return False
         shard_ok = None
         if rec is not None:
-            ok = solicitable_shards(
-                rec, self.state.n_shards,
-                min(t.resreq.get("cpu") for t in tasks),
-                min(t.resreq.get("memory") for t in tasks),
-                self.state.owned(),
-            )
+            with obs.span("gang:solicit", cat="federation"):
+                ok = solicitable_shards(
+                    rec, self.state.n_shards,
+                    min(t.resreq.get("cpu") for t in tasks),
+                    min(t.resreq.get("memory") for t in tasks),
+                    self.state.owned(),
+                )
             shard_ok = ok.__contains__
-        plan = self.filter.plan_gang_assembly(tasks, shard_ok=shard_ok)
+        with obs.span("gang:plan", cat="federation"):
+            plan = self.filter.plan_gang_assembly(tasks, shard_ok=shard_ok)
         if len(plan) < need:
             # the cluster (as this ledger sees it) cannot host the
             # minimum — the honest Pending outcome, counted so operator
@@ -296,7 +330,9 @@ class GangBroker:
             fresh.append(pre)
         t0 = time.perf_counter()
         try:
-            result = self.api.txn_commit(binds)
+            with obs.span("gang:txn_commit", cat="federation",
+                          args={"binds": len(binds)}):
+                result = self.api.txn_commit(binds)
         except ApiError as e:
             log.error("gang txn_commit for %s failed: %s", jid, e)
             self._count("aborted")

@@ -110,6 +110,11 @@ class FederatedScheduler:
         self.scheduler.post_cycle = self._post_cycle
         self._owned_event = threading.Event()
         self._crashed = False
+        #: this member's /metrics address, published on the lease-map
+        #: stats blob so `vtctl top` discovers the whole federation's
+        #: scrape targets from the shard map alone (set by the daemon
+        #: once its serving port is bound; empty = not serving)
+        self.metrics_addr = ""
 
     # ---- lease callbacks (lease-manager thread) ----
 
@@ -139,6 +144,8 @@ class FederatedScheduler:
             "rebalances": self.leases.rebalances,
             "sketch": self.filter.capacity_sketch(),
         }
+        if self.metrics_addr:
+            out["metricsAddr"] = self.metrics_addr
         if self.broker is not None:
             out["gangAssembly"] = self.broker.counters()
         return out

@@ -153,6 +153,18 @@ class SpilloverController:
         return spilled
 
     def _spill_one(self, task) -> bool:
+        from volcano_tpu import obs
+
+        if not obs.enabled():
+            return self._spill_one_inner(task)
+        with obs.span(
+            "spillover:cas_bind", cat="federation",
+            trace_id=obs.trace_id_for_pod(task.namespace, task.name),
+            args={"pod": f"{task.namespace}/{task.name}"},
+        ):
+            return self._spill_one_inner(task)
+
+    def _spill_one_inner(self, task) -> bool:
         candidates = self.filter.spill_candidates(
             task, limit=self.candidate_retries
         )

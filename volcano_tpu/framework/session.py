@@ -422,6 +422,9 @@ class Session:
     def allocate(self, task: TaskInfo, hostname: str) -> None:
         """session.go:247-303 — status updates in session; binds the whole
         job's Allocated set once the job turns ready."""
+        import time as _time
+
+        _t0 = _time.perf_counter()
         self.cache.allocate_volumes(task, hostname)
         job = self.jobs.get(task.job)
         if job is None:
@@ -440,6 +443,10 @@ class Session:
             # ready) is journaled as "bind" by dispatch below
             self._trace.decision("allocate", task.uid, hostname)
         self._fire_allocate(task)
+        # metrics.go UpdateTaskScheduleDuration: per-task allocation cost
+        from volcano_tpu.metrics import metrics as _metrics
+
+        _metrics.update_task_schedule_duration(_time.perf_counter() - _t0)
 
         if self.job_ready(job):
             for t in list(job.task_status_index.get(TaskStatus.Allocated, {}).values()):

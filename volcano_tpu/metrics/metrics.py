@@ -51,6 +51,12 @@ class _Registry:
         self._histograms: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], _Histogram] = {}  # guarded-by: self._lock
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = defaultdict(float)  # guarded-by: self._lock
         self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}  # guarded-by: self._lock
+        #: uniform identity labels merged into EVERY rendered series
+        #: (daemon / shard / replica_index / role) so federated scrapes
+        #: aggregated by ``vtctl top`` stay distinguishable without
+        #: scrape-config tricks; empty until set_identity() — tests and
+        #: library embedders see unchanged output
+        self._identity: Tuple[Tuple[str, str], ...] = ()  # guarded-by: self._lock
 
     def histogram(
         self,
@@ -77,17 +83,53 @@ class _Registry:
         with self._lock:
             self._gauges[key] = value
 
+    def set_identity(self, **labels: str) -> None:
+        """Install the uniform identity labels (non-empty values only);
+        they merge into every series at render time, so a role flip
+        (follower → leader) retags the whole exposition at the next
+        scrape."""
+        with self._lock:
+            self._identity = tuple(
+                sorted((k, v) for k, v in labels.items() if v)
+            )
+
+    def refresh_identity_role(self, role: str) -> None:
+        """Replace just the ``role`` identity label — called from the
+        replication role transitions (update_repl_role) so BOTH
+        directions retag: a deposed leader's series must stop claiming
+        role="leader" the moment it demotes, not only flip on
+        promotion.  No-op when no identity is installed (library
+        embedders, tests)."""
+        with self._lock:
+            if not self._identity or not role:
+                return
+            self._identity = tuple(sorted(
+                [(k, v) for k, v in self._identity if k != "role"]
+                + [("role", role)]
+            ))
+
     def render(self) -> str:
         """Prometheus text exposition format."""
         lines: List[str] = []
+        identity: Tuple[Tuple[str, str], ...] = ()
+
+        def merge(labels: Tuple[Tuple[str, str], ...]):
+            if not identity:
+                return labels
+            keys = {k for k, _v in labels}
+            return tuple(sorted(
+                labels + tuple((k, v) for k, v in identity if k not in keys)
+            ))
 
         def fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+            labels = merge(labels)
             if not labels:
                 return ""
             inner = ",".join(f'{k}="{v}"' for k, v in labels)
             return "{" + inner + "}"
 
         with self._lock:
+            identity = self._identity
             for (name, labels), h in sorted(self._histograms.items()):
                 cumulative = 0
                 for bound, c in zip(h.buckets, h.counts):
@@ -109,9 +151,77 @@ class _Registry:
             self._histograms.clear()
             self._counters.clear()
             self._gauges.clear()
+            self._identity = ()
 
 
 registry = _Registry()
+
+
+# ---- daemon identity + build info (the federated-scrape contract) ----
+# Every daemon stamps who it is once at startup; the registry merges
+# the labels into every rendered series, so `vtctl top` can aggregate
+# N schedulers + M apiserver replicas without scrape-config tricks.
+
+#: bounded role vocabulary for the identity label (MTR001 discipline)
+_IDENTITY_ROLES = (
+    "scheduler", "controllers", "admission", "apiserver",
+    "compute-plane", "leader", "follower", "standalone", "init",
+)
+
+
+def set_identity(
+    daemon: str,
+    shard: str = "",
+    replica_index: str = "",
+    role: str = "",
+) -> None:
+    """Install the uniform identity labels and the
+    ``volcano_build_info`` gauge.  role ∈ the _IDENTITY_ROLES
+    vocabulary (daemon kind, or leader/follower for apiserver
+    replicas); empty labels are omitted rather than rendered blank.
+    Call again on a role flip (promotion) — the whole exposition
+    retags at the next scrape."""
+    if role and role not in _IDENTITY_ROLES:
+        role = "other"
+    registry.set_identity(
+        daemon=daemon, shard=shard, replica_index=replica_index, role=role
+    )
+    from volcano_tpu import __version__
+
+    # label-vocab: version — the package __version__, one value per build
+    registry.set_gauge(
+        f"{_NAMESPACE}_build_info", {"version": __version__}, 1.0
+    )
+
+
+# ---- label-cardinality bound (MTR001: metric hygiene) ----
+# Some reference metrics carry a JOB label (gang.go's per-job
+# unschedulable gauges).  Job names are operator input — an unbounded
+# vocabulary that would mint one series per job forever.  This helper
+# is the declared bound: the first _LABEL_CARDINALITY_CAP distinct
+# values keep their own series, everything after lands under "other"
+# (with an eviction counter so saturation is visible, not silent).
+
+_LABEL_CARDINALITY_CAP = 256
+_label_values_lock = threading.Lock()
+_label_values: Dict[Tuple[str, str], set] = {}  # guarded-by: _label_values_lock
+
+
+def bounded_label(metric: str, label: str, value: str) -> str:
+    """Admit ``value`` into the metric's label vocabulary, or collapse
+    it to "other" once the per-(metric, label) cap is reached."""
+    key = (metric, label)
+    with _label_values_lock:
+        seen = _label_values.setdefault(key, set())
+        if value in seen:
+            return value
+        if len(seen) < _LABEL_CARDINALITY_CAP:
+            seen.add(value)
+            return value
+    registry.inc(
+        f"{_NAMESPACE}_metric_label_overflow_total", {"metric": metric}
+    )  # label-vocab: metric — the fixed set of bounded_label call sites
+    return "other"
 
 
 # ---- update helpers (metrics.go:124-171) ----
@@ -122,6 +232,8 @@ registry = _Registry()
 # the units now).
 
 def update_plugin_duration(plugin_name: str, seconds: float) -> None:
+    # label-vocab: plugin — the registered plugin-builder names
+    # (framework/plugins.py factory registry), a static set
     registry.histogram(
         f"{_NAMESPACE}_plugin_scheduling_latency_microseconds",
         {"plugin": plugin_name},
@@ -130,6 +242,8 @@ def update_plugin_duration(plugin_name: str, seconds: float) -> None:
 
 
 def update_action_duration(action_name: str, seconds: float) -> None:
+    # label-vocab: action — the registered action names
+    # (framework/plugins.py action registry), a static set
     registry.histogram(
         f"{_NAMESPACE}_action_scheduling_latency_microseconds",
         {"action": action_name},
@@ -168,6 +282,10 @@ def register_schedule_attempt(result: str) -> None:
 
 
 def update_pod_schedule_status(status: str, count: int = 1) -> None:
+    """metrics.go pod_schedule_successes/errors: pods whose bind effect
+    landed (or failed to land) on the bus.  status ∈ {successes,
+    errors} — the status names the metric, not a label, exactly the
+    reference's two-counter shape."""
     registry.inc(f"{_NAMESPACE}_pod_schedule_{status}", {}, count)
 
 
@@ -188,8 +306,9 @@ def register_unschedulable_reason(reason: str, tasks: int = 1) -> None:
 
     Host fit-error reasons can interpolate object names ('pvc "ns/x"
     not found') — an unbounded label value would mint one counter
-    series per stuck object, so anything outside the well-known reason
-    vocabulary lands under reason="other"."""
+    series per stuck object, so reason ∈ _well_known_reasons() plus
+    "other": anything outside the well-known vocabulary lands under
+    reason="other"."""
     if reason not in _well_known_reasons():
         reason = "other"
     registry.inc(
@@ -235,9 +354,18 @@ def update_explain_duration(seconds: float) -> None:
     registry.histogram(
         f"{_NAMESPACE}_explain_latency_milliseconds", {}
     ).observe(seconds * 1e3)
+    from volcano_tpu import obs
+
+    if obs.enabled():
+        obs.complete("explain", seconds, cat="explain")
 
 
 def update_unschedule_task_count(job_name: str, count: int) -> None:
+    """gang.go's per-job unready gauge.  job ∈ the bounded_label-capped
+    vocabulary: the first _LABEL_CARDINALITY_CAP job names keep their
+    own series, later ones collapse to job="other" (metric hygiene —
+    operator input must not mint unbounded series)."""
+    job_name = bounded_label("unschedule_task_count", "job", job_name)
     registry.set_gauge(f"{_NAMESPACE}_unschedule_task_count", {"job": job_name}, count)
 
 
@@ -246,6 +374,9 @@ def update_unschedule_job_count(count: int) -> None:
 
 
 def register_job_retries(job_name: str) -> None:
+    """job ∈ the bounded_label-capped vocabulary (see
+    update_unschedule_task_count)."""
+    job_name = bounded_label("job_retry_counts", "job", job_name)
     registry.inc(f"{_NAMESPACE}_job_retry_counts", {"job": job_name})
 
 
@@ -257,6 +388,8 @@ def register_job_retries(job_name: str) -> None:
 
 def observe_bus_request(method: str, seconds: float, code: str) -> None:
     """code ∈ {ok, error, timeout, disconnected}."""
+    # label-vocab: method — the protocol.OP_VERSIONS op registry plus
+    # "ping", a static set
     registry.inc(f"{_NAMESPACE}_bus_requests_total",
                  {"method": method, "code": code})
     registry.histogram(
@@ -269,10 +402,14 @@ def register_bus_reconnect() -> None:
 
 
 def register_bus_relist(kind: str) -> None:
+    # label-vocab: kind — the protocol.KINDS decode registry, a static
+    # set of K8sObject kinds
     registry.inc(f"{_NAMESPACE}_bus_relists_total", {"kind": kind})
 
 
 def register_bus_watch_event(kind: str) -> None:
+    # label-vocab: kind — the protocol.KINDS decode registry, a static
+    # set of K8sObject kinds
     registry.inc(f"{_NAMESPACE}_bus_watch_events_total", {"kind": kind})
 
 
@@ -304,6 +441,16 @@ def update_wal_size(size_bytes: int) -> None:
     registry.set_gauge(f"{_NAMESPACE}_wal_size_bytes", {}, size_bytes)
 
 
+def observe_repl_quorum_wait(seconds: float) -> None:
+    """volcano_repl_quorum_wait_milliseconds: how long a leader-side
+    write parked (outside the store lock) waiting for the follower
+    majority — the replication half of every acked write's tail, next
+    to the fsync half (`vtctl top`'s QUORUM column)."""
+    registry.histogram(
+        f"{_NAMESPACE}_repl_quorum_wait_milliseconds", {}
+    ).observe(seconds * 1e3)
+
+
 def update_repl_lag(entries: int) -> None:
     """volcano_repl_lag_entries: replication lag in log entries — on
     the leader, the slowest follower's deficit; on a follower, its own
@@ -320,9 +467,14 @@ def update_repl_role(role: str) -> None:
     role's series, 0 on the rest) so a promotion flip is a visible
     edge on both series."""
     for r in _REPL_ROLES:
+        # label-vocab: role — the _REPL_ROLES enum above
         registry.set_gauge(
             f"{_NAMESPACE}_repl_role", {"role": r}, 1.0 if r == role else 0.0
         )
+    # the identity `role` label follows the SAME transitions, both
+    # directions — a deposed leader must not keep exporting series
+    # tagged role="leader" next to the real leader's
+    registry.refresh_identity_role(role)
 
 
 def register_bus_recovery(kind: str) -> None:
@@ -333,6 +485,8 @@ def register_bus_recovery(kind: str) -> None:
 
 
 def observe_bus_server_request(op: str, seconds: float, code: str) -> None:
+    """code ∈ {ok, error}."""
+    # label-vocab: op — the protocol.OP_VERSIONS registry, a static set
     registry.inc(f"{_NAMESPACE}_bus_server_requests_total",
                  {"op": op, "code": code})
     registry.histogram(
@@ -353,6 +507,8 @@ def update_bus_server_watchers(count: int) -> None:
 def register_executor_fallback(from_: str, to: str, cause: str) -> None:
     """cause ∈ {error, circuit-open, deadline, corrupt-output,
     unhealthy}."""
+    # label-vocab: from, to — the executor rung names (ops/dispatch.py
+    # degradation ladder), a static set
     registry.inc(
         f"{_NAMESPACE}_executor_fallbacks_total",
         {"from": from_, "to": to, "cause": cause},
@@ -361,6 +517,8 @@ def register_executor_fallback(from_: str, to: str, cause: str) -> None:
 
 def update_circuit_breaker_state(executor: str, value: float) -> None:
     """0 = closed, 0.5 = half-open (probing), 1 = open (tripped)."""
+    # label-vocab: executor — the per-name breaker registry
+    # (faults/breaker.py), a static set of executor/seam names
     registry.set_gauge(
         f"{_NAMESPACE}_circuit_breaker_open", {"executor": executor}, value
     )
@@ -369,6 +527,8 @@ def update_circuit_breaker_state(executor: str, value: float) -> None:
 def register_fault_injected(point: str) -> None:
     """One count per fault-plane firing — lets a chaos run's metrics be
     cross-checked against its trace journal."""
+    # label-vocab: point — the parsed fault schedule's point names
+    # (finitely many per process; chaos harnesses only, never prod)
     registry.inc(f"{_NAMESPACE}_faults_injected_total", {"point": point})
 
 
@@ -426,8 +586,8 @@ def register_commit_failure(kind: str) -> None:
 
 def register_micro_cycle(trigger: str) -> None:
     """volcano_micro_cycles_total{trigger}: one count per event-driven
-    micro-cycle; ``trigger`` is the coalesced watch-event category that
-    woke the loop (task / node / group / mixed)."""
+    micro-cycle; trigger ∈ {task, node, group, gang, topology, mixed} —
+    the coalesced watch-event category that woke the loop."""
     registry.inc(f"{_NAMESPACE}_micro_cycles_total", {"trigger": trigger})
 
 
@@ -539,6 +699,30 @@ def observe_txn_commit(seconds: float) -> None:
     ).observe(seconds * 1e3)
 
 
+# ---- flight recorder telemetry channel (volcano_tpu/obs) ----
+# The channel's one invariant is drop-not-block, so the drop counter
+# IS the health signal: a non-zero rate under steady load means the
+# ring is undersized or the bus is rejecting segments.
+
+
+def register_telemetry_dropped(reason: str, count: int = 1) -> None:
+    """volcano_telemetry_dropped_total{reason}: spans the telemetry
+    channel dropped instead of blocking a cycle.  reason ∈ {ring-full,
+    export-error}."""
+    registry.inc(
+        f"{_NAMESPACE}_telemetry_dropped_total", {"reason": reason}, count
+    )
+
+
+def observe_telemetry_batch(size: int) -> None:
+    """volcano_telemetry_batch_size: spans per exported segment batch
+    (the channel's achieved batching; mass at 1 means the flush
+    interval is outrunning emission)."""
+    registry.histogram(
+        f"{_NAMESPACE}_telemetry_batch_size", {}, buckets=_COALESCE_BUCKETS
+    ).observe(size)
+
+
 # ---- TPU-build additions: per-kernel phase timings ----
 
 def update_kernel_duration(phase: str, seconds: float) -> None:
@@ -558,3 +742,9 @@ def update_kernel_duration(phase: str, seconds: float) -> None:
         rec.complete(
             f"kernel:{phase}", "kernel", time.perf_counter() - seconds, seconds
         )
+    from volcano_tpu import obs
+
+    if obs.enabled():
+        # third sink: the flight recorder — kernel phases land in the
+        # cross-process waterfall parented to the cycle span
+        obs.complete(f"kernel:{phase}", seconds, cat="kernel")

@@ -1,0 +1,95 @@
+"""volcano_tpu.obs — the cluster-wide flight recorder.
+
+Three pieces (ISSUE 12):
+
+  * **spans** — cross-process span contexts ``(trace_id, span_id,
+    parent_id)`` with trace ids derived from pod/gang identity,
+    propagated over VBUS request payloads next to the PR 4 cycle
+    correlation field (spans.py); zero-cost when disabled.
+  * **channel** — a drop-not-block telemetry export: bounded ring →
+    batched segment objects on the bus, sampled by trace_id, so the
+    apiserver's watch/WAL/replication machinery is the collector and
+    spans survive daemon death up to the last flush (channel.py).
+  * **collect** — assembly + rendering: the submit→bind waterfall
+    across processes, merged multi-process Chrome export, and the
+    loadgen stage-breakdown attribution (collect.py).
+
+Usage::
+
+    from volcano_tpu import obs
+
+    obs.enable(api, identity="vtpu-scheduler-0")
+    with obs.span("cycle", cat="scheduler"):
+        ...
+    # later, from any client of the same bus:
+    spans = obs.collect_spans(api)
+    obs.render_waterfall(obs.select_trace(spans, "default", "pod-1"), out)
+
+Instrumented code calls :func:`span`/:func:`complete` unconditionally —
+with the recorder off they cost one attribute read and return a shared
+null context.
+"""
+
+from __future__ import annotations
+
+from volcano_tpu.obs.channel import (  # noqa: F401
+    NAMESPACE,
+    SEGMENT_KEY,
+    SEGMENT_PREFIX,
+    SpanExporter,
+    disable,
+    enable,
+)
+from volcano_tpu.obs.collect import (  # noqa: F401
+    build_tree,
+    chrome_export,
+    collect_spans,
+    related_identities,
+    render_waterfall,
+    select_trace,
+    select_union,
+    stage_breakdown,
+)
+from volcano_tpu.obs.spans import (  # noqa: F401
+    Span,
+    adopt,
+    complete,
+    current,
+    current_wire,
+    enabled,
+    get_exporter,
+    span,
+    suppressed,
+    trace_id_for,
+    trace_id_for_gang,
+    trace_id_for_pod,
+)
+
+__all__ = [
+    "NAMESPACE",
+    "SEGMENT_KEY",
+    "SEGMENT_PREFIX",
+    "Span",
+    "SpanExporter",
+    "adopt",
+    "build_tree",
+    "chrome_export",
+    "collect_spans",
+    "complete",
+    "current",
+    "related_identities",
+    "select_union",
+    "current_wire",
+    "disable",
+    "enable",
+    "enabled",
+    "get_exporter",
+    "render_waterfall",
+    "select_trace",
+    "span",
+    "stage_breakdown",
+    "suppressed",
+    "trace_id_for",
+    "trace_id_for_gang",
+    "trace_id_for_pod",
+]

@@ -1,0 +1,221 @@
+"""The telemetry bus channel — span batches as low-priority bus objects.
+
+Spans are forensics, not control state, so the channel's one invariant
+is **drop-not-block**: emission is a bounded in-memory ring append
+(never a lock the scheduler contends, never I/O), and a background
+flusher ships batches to the bus on its own clock.  When the ring is
+full, or the bus is down, or the WAL refuses the write, spans are
+*dropped and counted* (``volcano_telemetry_dropped_total{reason}``) —
+telemetry must never sit on the store lock or the commit path, and a
+chaos schedule with the flight recorder on stays bit-identical to its
+fault-free twin (tests/test_obs.py pins it).
+
+Segments land as ConfigMap objects in the ``volcano-telemetry``
+namespace, one bounded ring of ``segments`` slots per daemon
+(``vtpu-spans-<identity>-<slot>``), so the apiserver's existing
+watch/WAL/replication machinery *is* the collector: spans survive
+daemon death up to the last flush, follow the leader across failover,
+and are readable by ``vtctl trace`` from any replica.  Retention is
+honest and bounded: slot ``seq % segments`` overwrites the oldest
+batch, so a daemon retains its most recent ``segments × batch`` spans
+and no more.
+
+Sampling is by **trace_id** (the Dapper discipline): a trace is kept
+or dropped whole, identically in every process, because the decision
+hashes the id itself.  Default sample rate comes from
+``VTPU_TELEMETRY_SAMPLE`` (1.0 = keep everything).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+from collections import deque
+from typing import List, Optional
+
+from volcano_tpu.metrics import metrics
+from volcano_tpu.obs import spans as _spans
+from volcano_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: the telemetry namespace — informers never watch ConfigMaps, so
+#: segment churn cannot wake a micro-cycle or dirty a pack cache
+NAMESPACE = "volcano-telemetry"
+SEGMENT_KEY = "spans.volcano.tpu/batch"
+SEGMENT_PREFIX = "vtpu-spans-"
+
+
+def _env_sample() -> float:
+    try:
+        return min(1.0, max(0.0, float(
+            os.environ.get("VTPU_TELEMETRY_SAMPLE", "1.0")
+        )))
+    except ValueError:
+        return 1.0
+
+
+class SpanExporter:
+    """Bounded ring + batcher + bus flusher for one daemon's spans."""
+
+    def __init__(
+        self,
+        api,
+        identity: str,
+        ring: int = 8192,
+        segments: int = 16,
+        batch: int = 2048,
+        flush_interval: float = 0.25,
+        sample: Optional[float] = None,
+    ):
+        self.api = api
+        self.identity = identity
+        self.token = _spans._proc_token(identity)
+        self.pid = os.getpid()
+        self.ring_cap = max(1, ring)
+        self.segments = max(1, segments)
+        self.batch = max(1, batch)
+        self.flush_interval = flush_interval
+        self.sample = _env_sample() if sample is None else sample
+        self._lock = threading.Lock()
+        self._ring: deque = deque()  # guarded-by: self._lock
+        self._seq = 0  # guarded-by: self._lock
+        #: observability for tests; the metric is the operator surface
+        self.dropped = 0  # guarded-by: self._lock
+        self.exported = 0  # guarded-by: self._lock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- emission (any thread — must stay O(1), lock-only) ----
+
+    def keep(self, trace_id: str) -> bool:
+        """Trace-id sampling: "" (process-scope spans) always kept;
+        otherwise the id's hash decides, so every process keeps or
+        drops a given trace identically."""
+        if self.sample >= 1.0 or not trace_id:
+            return True
+        if self.sample <= 0.0:
+            return False
+        return (zlib.crc32(trace_id.encode()) % 10_000) < self.sample * 10_000
+
+    def emit(self, record: dict) -> None:
+        with self._lock:
+            if len(self._ring) >= self.ring_cap:
+                self.dropped += 1
+                dropped = True
+            else:
+                self._ring.append(record)
+                dropped = False
+        if dropped:
+            metrics.register_telemetry_dropped("ring-full")
+
+    # ---- flush (the exporter's own thread, or tests) ----
+
+    def _drain(self) -> List[dict]:
+        with self._lock:
+            n = min(len(self._ring), self.batch)
+            return [self._ring.popleft() for _ in range(n)]
+
+    def flush(self) -> int:
+        """Ship up to one batch; returns spans shipped (0 = ring empty
+        or the write failed — failures DROP, with the counter bumped)."""
+        batch = self._drain()
+        if not batch:
+            return 0
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        slot = seq % self.segments
+        name = f"{SEGMENT_PREFIX}{self.identity}-{slot:02d}"
+        payload = json.dumps({
+            "daemon": self.identity,
+            "pid": self.pid,
+            "seq": seq,
+            "spans": batch,
+        }, separators=(",", ":"))
+        try:
+            # the exporter's own bus traffic must not trace itself
+            with _spans.suppressed():
+                self._write_segment(name, payload)
+        except Exception as e:  # noqa: BLE001 — drop-not-block: a bus
+            # outage, WAL write failure, or admission deny costs this
+            # batch, never a cycle and never an exception into a daemon
+            with self._lock:
+                self.dropped += len(batch)
+            metrics.register_telemetry_dropped("export-error", len(batch))
+            log.debug("telemetry export dropped %d span(s): %s",
+                      len(batch), e)
+            return 0
+        with self._lock:
+            self.exported += len(batch)
+        metrics.observe_telemetry_batch(len(batch))
+        return len(batch)
+
+    def _write_segment(self, name: str, payload: str) -> None:
+        from volcano_tpu.apis import core
+        from volcano_tpu.client.apiserver import AlreadyExistsError
+
+        data = {SEGMENT_KEY: payload}
+        try:
+            self.api.create(core.ConfigMap(
+                metadata=core.ObjectMeta(name=name, namespace=NAMESPACE),
+                data=data,
+            ))
+        except AlreadyExistsError:
+            cm = self.api.get("ConfigMap", NAMESPACE, name)
+            if cm is None:  # deleted between create and get — rare; drop
+                raise
+            cm.data = data
+            self.api.update(cm)
+
+    def flush_all(self, limit: int = 64) -> int:
+        """Drain the whole ring (graceful shutdown / tests)."""
+        total = 0
+        for _ in range(limit):
+            n = self.flush()
+            if n == 0:
+                break
+            total += n
+        return total
+
+    # ---- lifecycle ----
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.flush_interval):
+            self.flush()
+        self.flush_all()  # best-effort final drain
+
+    def start(self) -> "SpanExporter":
+        self._thread = threading.Thread(
+            target=self._loop, name=f"vtpu-telemetry-{self.identity}",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def enable(api, identity: str, **kw) -> SpanExporter:
+    """Install the process-global flight recorder: spans emitted via
+    :mod:`volcano_tpu.obs` batch through a :class:`SpanExporter` onto
+    ``api``.  Replaces (and stops) a previously installed exporter."""
+    prev = _spans.get_exporter()
+    if prev is not None:
+        prev.stop()
+    exp = SpanExporter(api, identity, **kw).start()
+    _spans._set_exporter(exp)
+    return exp
+
+
+def disable() -> None:
+    exp = _spans.get_exporter()
+    _spans._set_exporter(None)
+    if exp is not None:
+        exp.stop()

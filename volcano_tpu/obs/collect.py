@@ -1,0 +1,290 @@
+"""Flight-recorder collection + rendering — the read side of the
+telemetry channel.
+
+Spans are collected from the segment ConfigMaps every daemon's
+:class:`~volcano_tpu.obs.channel.SpanExporter` ships to the bus, so a
+pod's waterfall is assembled *after the fact* from whatever the
+cluster durably holds — including spans from daemons that have since
+died.  All reads go through the API surface only, so ``vtctl trace
+pod``/``gang`` render identically over the in-process backend and
+``--bus`` (the ``vtctl shards`` discipline).
+
+Selection is two-step: spans matching the pod/gang identity directly
+(trace_id, or the ``gang``/``pod`` span args), then the **ancestor
+closure** — every span reachable by following ``parent_id`` upward
+through the full collected set, regardless of its own trace_id.  That
+is what stitches a pod's ``bind:landed`` span to the commit-plane
+flush that carried it, the bus op that shipped it, the WAL fsync and
+quorum wait that made it durable, and the scheduling cycle that
+decided it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Optional, TextIO
+
+from volcano_tpu.obs.channel import NAMESPACE, SEGMENT_KEY, SEGMENT_PREFIX
+from volcano_tpu.obs.spans import trace_id_for
+
+
+def collect_spans(api, namespace: str = NAMESPACE) -> List[Dict[str, Any]]:
+    """Every span durably held in the telemetry namespace, stamped with
+    its segment's daemon identity and pid, sorted by start time."""
+    out: List[Dict[str, Any]] = []
+    for cm in api.list("ConfigMap", namespace):
+        name = cm.metadata.name or ""
+        if not name.startswith(SEGMENT_PREFIX):
+            continue
+        try:
+            seg = json.loads((cm.data or {}).get(SEGMENT_KEY, ""))
+        except (ValueError, AttributeError):
+            continue
+        daemon = seg.get("daemon", "")
+        pid = seg.get("pid", 0)
+        for s in seg.get("spans", []):
+            s = dict(s)
+            s.setdefault("daemon", daemon)
+            s.setdefault("pid", pid)
+            out.append(s)
+    out.sort(key=lambda s: (s.get("ts", 0.0), s.get("s", "")))
+    return out
+
+
+def _matches(span: Dict[str, Any], trace_id: str, ident: str) -> bool:
+    if span.get("t") == trace_id:
+        return True
+    args = span.get("args") or {}
+    return ident in (args.get("pod"), args.get("gang"), args.get("job"))
+
+
+def select_trace(
+    spans: Iterable[Dict[str, Any]], namespace: str, name: str
+) -> List[Dict[str, Any]]:
+    """Spans belonging to one pod/gang identity, plus (a) the ancestor
+    closure that parents them — cycles, bus ops, fsyncs — and (b) the
+    *process-scope* descendants of those ancestors (kernel / pack /
+    explain sub-spans of the cycle that placed this pod).  Spans keyed
+    to OTHER pod/gang identities never leak in: the downward closure
+    admits only trace_id == "" spans."""
+    spans = list(spans)
+    tid = trace_id_for(namespace, name)
+    ident = f"{namespace}/{name}"
+    by_id = {s.get("s"): s for s in spans}
+    children: Dict[str, List[Dict[str, Any]]] = {}
+    for s in spans:
+        children.setdefault(s.get("p", ""), []).append(s)
+    picked: Dict[str, Dict[str, Any]] = {}
+    frontier = [s for s in spans if _matches(s, tid, ident)]
+    while frontier:
+        nxt = []
+        for s in frontier:
+            sid = s.get("s")
+            if sid in picked:
+                continue
+            picked[sid] = s
+            parent = by_id.get(s.get("p", ""))
+            if parent is not None:
+                nxt.append(parent)
+        frontier = nxt
+    # downward: process-scope sub-spans of anything already picked
+    frontier = list(picked.values())
+    while frontier:
+        nxt = []
+        for s in frontier:
+            for c in children.get(s.get("s"), ()):
+                cid = c.get("s")
+                if cid in picked or c.get("t", ""):
+                    continue
+                picked[cid] = c
+                nxt.append(c)
+        frontier = nxt
+    out = list(picked.values())
+    out.sort(key=lambda s: (s.get("ts", 0.0), s.get("s", "")))
+    return out
+
+
+def select_union(
+    spans: Iterable[Dict[str, Any]], identities: Iterable[tuple]
+) -> List[Dict[str, Any]]:
+    """Union of :func:`select_trace` over several (namespace, name)
+    identities, deduplicated and time-ordered.  A pod's full story
+    spans THREE identities — the pod itself, its PodGroup (gang), and
+    its owning Job (the controller's status-writeback trace) — and the
+    caller (vtctl) derives them from the store objects."""
+    spans = list(spans)
+    picked: Dict[str, Dict[str, Any]] = {}
+    for namespace, name in identities:
+        for s in select_trace(spans, namespace, name):
+            picked[s.get("s")] = s
+    out = list(picked.values())
+    out.sort(key=lambda s: (s.get("ts", 0.0), s.get("s", "")))
+    return out
+
+
+def related_identities(api, namespace: str, name: str) -> List[tuple]:
+    """The identities whose traces make up one pod/gang waterfall:
+    the name itself, plus — when the store still holds the pod — its
+    PodGroup (group annotation) and owning Job (job-name annotation /
+    ownerReference).  Best-effort: a deleted pod degrades to the bare
+    identity."""
+    idents = [(namespace, name)]
+    try:
+        pod = api.get("Pod", namespace, name)
+    except Exception:  # noqa: BLE001 — collection must not fail on reads
+        pod = None
+    if pod is not None:
+        ann = pod.metadata.annotations or {}
+        from volcano_tpu.apis import scheduling as _sched
+
+        group = ann.get(_sched.GROUP_NAME_ANNOTATION_KEY, "")
+        if group and (namespace, group) not in idents:
+            idents.append((namespace, group))
+        for ref in pod.metadata.owner_references or ():
+            if getattr(ref, "kind", "") == "Job" and ref.name:
+                if (namespace, ref.name) not in idents:
+                    idents.append((namespace, ref.name))
+    return idents
+
+
+def build_tree(spans: List[Dict[str, Any]]):
+    """→ (roots, children) with children keyed by span id, both in
+    start-time order.  A span whose parent is not in the set is a
+    root (its parent was sampled out, pruned, or never flushed)."""
+    ids = {s.get("s") for s in spans}
+    children: Dict[str, List[Dict[str, Any]]] = {}
+    roots: List[Dict[str, Any]] = []
+    for s in spans:
+        p = s.get("p", "")
+        if p and p in ids:
+            children.setdefault(p, []).append(s)
+        else:
+            roots.append(s)
+    return roots, children
+
+
+def render_waterfall(
+    spans: List[Dict[str, Any]], out: TextIO,
+    clock0_us: Optional[float] = None,
+) -> None:
+    """Text waterfall: one line per span, indented by tree depth, with
+    offset from the earliest span and duration — the submit→bind
+    decomposition at a glance.  Offsets share one wall-clock origin
+    across processes (obs/spans.py docstring notes the skew caveat)."""
+    if not spans:
+        print("no spans recorded for this identity "
+              "(is the flight recorder enabled? sampled out?)", file=out)
+        return
+    roots, children = build_tree(spans)
+    t0 = clock0_us if clock0_us is not None else min(
+        s.get("ts", 0.0) for s in spans
+    )
+    print(f"{'OFFSET':>10} {'DURATION':>10}  {'DAEMON':<24} SPAN", file=out)
+
+    def walk(s: Dict[str, Any], depth: int) -> None:
+        off_ms = (s.get("ts", 0.0) - t0) / 1e3
+        dur_ms = s.get("dur", 0.0) / 1e3
+        label = s.get("name", "")
+        args = s.get("args") or {}
+        detail = " ".join(
+            f"{k}={args[k]}" for k in sorted(args) if k not in ("pod",)
+        )
+        print(
+            f"{off_ms:>9.2f}ms {dur_ms:>8.2f}ms  "
+            f"{s.get('daemon', '') or '?':<24} "
+            f"{'  ' * depth}{label}"
+            + (f"  [{detail}]" if detail else ""),
+            file=out,
+        )
+        for c in children.get(s.get("s"), []):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    daemons = sorted({s.get("daemon", "") for s in spans if s.get("daemon")})
+    pids = sorted({s.get("pid", 0) for s in spans})
+    print(
+        f"{len(spans)} span(s) across {len(daemons)} daemon(s) "
+        f"/ {len(pids)} process(es): {', '.join(daemons)}",
+        file=out,
+    )
+
+
+def chrome_export(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merged multi-process Chrome ``trace_event`` JSON: one pid row
+    per (daemon, os pid) with real thread ids, all on the shared
+    wall-clock origin — open in chrome://tracing / Perfetto."""
+    if not spans:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(s.get("ts", 0.0) for s in spans)
+    events: List[Dict[str, Any]] = []
+    seen_pids: Dict[tuple, int] = {}
+    for s in spans:
+        key = (s.get("daemon", ""), s.get("pid", 0))
+        pid = seen_pids.get(key)
+        if pid is None:
+            pid = s.get("pid", 0) or (len(seen_pids) + 1)
+            # two daemons in one test process still get distinct rows
+            while pid in seen_pids.values():
+                pid += 1
+            seen_pids[key] = pid
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": key[0] or f"pid {key[1]}"},
+            })
+        ev = {
+            "name": s.get("name", ""),
+            "cat": s.get("cat", "span"),
+            "ph": "X",
+            "ts": s.get("ts", 0.0) - t0,
+            "dur": s.get("dur", 0.0),
+            "pid": pid,
+            "tid": s.get("tid", 1),
+        }
+        args = dict(s.get("args") or {})
+        args["trace_id"] = s.get("t", "")
+        args["span_id"] = s.get("s", "")
+        if s.get("p"):
+            args["parent_id"] = s["p"]
+        ev["args"] = args
+        events.append(ev)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "clock_origin_us": t0,
+            "processes": {str(v): f"{k[0]} (pid {k[1]})"
+                          for k, v in seen_pids.items()},
+        },
+    }
+
+
+def stage_breakdown(
+    spans: List[Dict[str, Any]], pods: Iterable[tuple]
+) -> Dict[str, Any]:
+    """Attribute each pod's submit→bind path to named stages from its
+    collected spans — the ``bench/loadgen.py --stage-breakdown`` report
+    body.  ``pods`` is an iterable of (namespace, name).  Per stage:
+    count, mean_ms and p99_ms over the pods that exhibit it."""
+    per_stage: Dict[str, List[float]] = {}
+    covered = 0
+    all_spans = list(spans)
+    for namespace, name in pods:
+        trace = select_trace(all_spans, namespace, name)
+        if not trace:
+            continue
+        covered += 1
+        for s in trace:
+            per_stage.setdefault(s.get("name", "?"), []).append(
+                s.get("dur", 0.0) / 1e3
+            )
+    stages = {}
+    for stage, durs in sorted(per_stage.items()):
+        durs.sort()
+        stages[stage] = {
+            "count": len(durs),
+            "mean_ms": round(sum(durs) / len(durs), 3),
+            "p99_ms": round(durs[min(len(durs) - 1,
+                                     int(len(durs) * 0.99))], 3),
+        }
+    return {"pods_with_spans": covered, "stages": stages}

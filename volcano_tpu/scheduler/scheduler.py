@@ -254,7 +254,21 @@ class Scheduler:
         # (bus/remote.py) so bus/controller-side records can be joined
         # back to the scheduling cycle that caused them
         self._cycle_seq += 1
-        trace.set_current_cycle(cid if cid >= 0 else self._cycle_seq)
+        cycle_no = cid if cid >= 0 else self._cycle_seq
+        trace.set_current_cycle(cycle_no)
+        # flight-recorder cycle span (volcano_tpu/obs): a process-scope
+        # span that per-pod bind/commit spans parent to, and the ambient
+        # context every VBUS request this cycle issues propagates
+        # (bus/remote.py).  Entered manually so the existing
+        # try/finally journaling structure stays untouched; with the
+        # recorder off this is the shared null span.
+        from volcano_tpu import obs
+
+        obs_span = obs.span(
+            f"cycle:{trigger if micro else 'full'}", cat="scheduler",
+            args={"cycle": cycle_no},
+        )
+        obs_span.__enter__()
         start = time.perf_counter()
         ssn = None
         try:
@@ -264,7 +278,8 @@ class Scheduler:
             ssn = open_session(self.cache, conf.tiers, conf.configurations)
             for action in actions:
                 action_start = time.perf_counter()
-                action.execute(ssn)
+                with obs.span(f"action:{action.name()}", cat="action"):
+                    action.execute(ssn)
                 action_s = time.perf_counter() - action_start
                 metrics.update_action_duration(action.name(), action_s)
                 if rec.enabled:
@@ -300,6 +315,7 @@ class Scheduler:
                 # session open, an action, OR session close is exactly
                 # the one the forensics journal must not drop
                 rec.end_cycle(duration_s=elapsed)
+                obs_span.__exit__(None, None, None)
                 self.cache.in_micro_cycle = False
         metrics.update_e2e_duration(elapsed)
         if micro:

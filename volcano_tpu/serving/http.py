@@ -224,6 +224,10 @@ class ServingServer:
         assert self._httpd is not None, "server not started"
         return self._httpd.server_address[1]
 
+    @property
+    def host(self) -> str:
+        return self._host
+
     def start(self) -> "ServingServer":
         self._httpd = ThreadingHTTPServer((self._host, self._port), _Handler)
         self._httpd.registry = self._registry

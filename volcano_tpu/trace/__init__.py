@@ -30,7 +30,12 @@ from __future__ import annotations
 from typing import Optional
 
 from volcano_tpu.trace import export, journal, replay  # noqa: F401
-from volcano_tpu.trace.export import chrome_trace, export_chrome_trace
+from volcano_tpu.trace.export import (
+    chrome_trace,
+    export_chrome_trace,
+    export_merged_chrome_trace,
+    merge_chrome_traces,
+)
 from volcano_tpu.trace.journal import Journal
 from volcano_tpu.trace.recorder import NullRecorder, TraceRecorder
 from volcano_tpu.trace.replay import ReplayResult, run_snapshot, verify
@@ -97,7 +102,9 @@ __all__ = [
     "disable",
     "enable",
     "export_chrome_trace",
+    "export_merged_chrome_trace",
     "get_recorder",
+    "merge_chrome_traces",
     "replay",
     "run_snapshot",
     "set_recorder",
