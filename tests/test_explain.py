@@ -638,6 +638,7 @@ class TestExplainEndpoint:
                     f"http://127.0.0.1:{server.port}/explain?job=missing"
                 )
             assert e.value.code == 404
+            e.value.close()  # the HTTPError holds the response socket
         finally:
             server.stop()
 
@@ -651,6 +652,7 @@ class TestExplainEndpoint:
                     f"http://127.0.0.1:{server.port}/explain"
                 )
             assert e.value.code == 404
+            e.value.close()  # the HTTPError holds the response socket
         finally:
             server.stop()
 

@@ -405,6 +405,7 @@ def test_trace_last_endpoint(tmp_path):
         with pytest.raises(urllib.error.HTTPError) as err:
             urllib.request.urlopen(url)
         assert err.value.code == 404
+        err.value.close()  # the HTTPError holds the response socket
 
         trace.enable(str(tmp_path))
         Scheduler(_tiny_cluster_cache()).run_once()

@@ -20,9 +20,10 @@ machine-checked:
   tracers, no ``.item()`` / ``float()`` concretization inside jit, no
   reuse of a donated buffer after the donating call.
 * :mod:`~volcano_tpu.analysis.serde_drift` — every frame kind in
-  ``bus/protocol.py`` has a serde round-trip exemplar, and every bus op
+  ``bus/protocol.py`` has a serde round-trip exemplar, every bus op
   is version-registered (ops past ``MIN_VERSION`` must carry the
-  old-peer fallback).
+  old-peer fallback), and the README's VBUS version ladder declares
+  the current version and names every registered op (SRD005).
 * :mod:`~volcano_tpu.analysis.metric_hygiene` — every Counter/Histogram
   label with a non-literal value declares a statically bounded
   vocabulary (docstring ``label ∈ {...}`` or ``# label-vocab:``), and
@@ -32,10 +33,23 @@ machine-checked:
 Run ``python -m volcano_tpu.analysis`` (or ``vtctl lint``); CI fails on
 any finding not suppressed in the checked-in ``baseline.json``.
 
-The runtime half is :mod:`~volcano_tpu.analysis.lock_order` — the
-opt-in (``VTPU_LOCK_ORDER=1``) instrumented-lock wrapper that records
-the cross-thread lock-acquisition graph during the chaos / commit-plane
-suites and fails on cycles.
+The runtime half is three engines:
+
+* :mod:`~volcano_tpu.analysis.lock_order` — the opt-in
+  (``VTPU_LOCK_ORDER=1``) instrumented-lock wrapper that records the
+  cross-thread lock-acquisition graph during the chaos / commit-plane
+  suites and fails on cycles.
+* :mod:`~volcano_tpu.analysis.race` — the opt-in (``VTPU_RACE=1``)
+  happens-before race detector: vector clocks over the same lock
+  proxies plus thread/queue/event sync edges, with every
+  ``# guarded-by:``-declared attribute wrapped in a tracking
+  descriptor, so aliased and cross-module accesses the lexical pass
+  cannot see are checked at runtime (declaration layer: LCK;
+  enforcement layer: this).
+* :mod:`~volcano_tpu.analysis.explore` — the deterministic
+  interleaving explorer (``vtctl explore``): the election / lease /
+  gang-assembly protocols swept across hundreds of seed-replayable
+  schedules with four invariants asserted after every step.
 """
 
 from volcano_tpu.analysis.core import (  # noqa: F401 — public surface

@@ -1,0 +1,939 @@
+"""Deterministic interleaving explorer for the bus/federation protocols.
+
+The chaos smokes explore ONE interleaving per run — whatever the OS
+scheduler happened to produce under that seed.  This module is the
+CHESS-style complement: the election, lease absorb/shed and gang
+assembly state machines run **in-process under a controlled
+scheduler**, and every schedule — which message is delivered next,
+which fault point fires, who crashes when — is a deterministic function
+of one integer seed.  Hundreds of distinct schedules per run, each
+replayable from its seed alone.
+
+Three machines, four pinned invariants:
+
+* ``election`` — a model of ``bus/replication.py``'s leader protocol at
+  action granularity (probe+decide+promote is one atomic action, the
+  window the real stagger/re-probe protects): writes, shipments,
+  quorum acks, crash/restart with durable logs, elections.  Invariants:
+  **at most one leader per term**, and **no acked-then-lost write**
+  (every client-acked write is in the live leader's log, across any
+  crash/election sequence).
+* ``lease`` — the REAL :class:`~volcano_tpu.federation.leases.
+  ShardLeaseManager` ticking against a real in-process ``APIServer``
+  under a fake clock: the explorer permutes tick order, clock advances
+  and member crashes.  Invariant: **no doubly-owned shard slice** (two
+  live members never both hold a slice within their renewal validity).
+* ``gang`` — the REAL :meth:`~volcano_tpu.client.apiserver.APIServer.
+  txn_commit` driven by two racing assembly planners with stale-claim
+  injection and mid-assembly crashes.  Invariant: **no partial gang
+  below minMember** (bound members ∈ {0} ∪ [minMember, size] at every
+  observable state).
+
+Fault-point firing reuses the ``faults/`` plane grammar: each schedule
+builds a :class:`~volcano_tpu.faults.plane.FaultPlane` seeded by the
+schedule id, so ``repl.drop`` / ``bus.leader_kill`` /
+``lease.cas_fail`` / ``gang.kill_mid_assembly`` fire deterministically
+per schedule and replay identically.
+
+Schedules: low seeds walk the decision tree systematically (the seed is
+consumed as a mixed-radix numeral, one digit per choice, so every
+distinct decision prefix below the systematic budget is visited
+exactly once); seeds past the budget drive seeded-random choices.
+``vtctl explore --replay <machine>:<seed>`` re-runs one schedule and
+prints its full action trace.
+
+Planted bugs (``--plant``) prove the engine catches what it claims to:
+``stale-election`` splits probe from promote so two candidates promote
+on stale views (dual leader, same term); ``partial-commit`` replays a
+gang as per-member ``cas_bind``s that ignore conflicts (the exact
+replay the VBUS old-peer fallback forbids); ``lease-steal`` treats
+every lease as expired at claim time.  Each is caught, named, and
+replayable — and none of them is reachable through the unplanted
+protocols across the whole schedule budget, which is the regression
+net ROADMAP items 4–5 rewrite under.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import random as _random
+import sys
+from typing import Callable, Dict, List, Optional, Tuple
+
+from volcano_tpu.faults.plane import FaultPlane, parse_faults
+
+#: schedule seeds below this walk the choice tree systematically
+SYSTEMATIC_BUDGET = 64
+
+#: default per-schedule step budget; a violation found under a
+#: non-default budget carries it in its replay command (the budget is
+#: part of the schedule's identity, like --plant/--faults)
+_MAX_STEPS = 60
+
+PLANTS = ("stale-election", "partial-commit", "lease-steal")
+
+
+class Schedule:
+    """One replayable interleaving, fully determined by ``sid``."""
+
+    def __init__(self, sid: int, systematic_below: int = SYSTEMATIC_BUDGET):
+        self.sid = sid
+        self._rng = _random.Random(0x9E3779B9 ^ sid)
+        #: mixed-radix systematic prefix: digits of ``sid`` in the radix
+        #: sequence of choice arities, most-significant last — every
+        #: distinct prefix below the budget is visited exactly once
+        self._forced: Optional[int] = (
+            sid if 0 <= sid < systematic_below else None
+        )
+        self.choices: List[int] = []
+
+    def choose(self, n: int) -> int:
+        """Pick one of ``n`` alternatives."""
+        if n <= 1:
+            self.choices.append(0)
+            return 0
+        if self._forced is not None:
+            c = self._forced % n
+            self._forced //= n
+            if self._forced == 0:
+                self._forced = None
+            self.choices.append(c)
+            return c
+        c = self._rng.randrange(n)
+        self.choices.append(c)
+        return c
+
+
+class Violation:
+    """One invariant violation, replayable from ``(machine, sid)`` —
+    plus the ``--plant``/``--faults`` flags it was found under, which
+    are part of the schedule's identity: the printed replay command
+    must reproduce the trace bit-for-bit, and a plant-found violation
+    replayed without the plant is (by design) clean."""
+
+    def __init__(self, machine: str, sid: int, step: int, invariant: str,
+                 trace: List[str], plant: Optional[str] = None,
+                 faults: Optional[str] = None,
+                 max_steps: Optional[int] = None):
+        self.machine = machine
+        self.sid = sid
+        self.step = step
+        self.invariant = invariant
+        self.trace = trace
+        self.plant = plant
+        self.faults = faults
+        self.max_steps = max_steps
+
+    def render(self) -> str:
+        tail = self.trace[-12:]
+        pre = "... " if len(self.trace) > 12 else ""
+        replay = f"vtctl explore --replay {self.machine}:{self.sid}"
+        if self.plant:
+            replay += f" --plant {self.plant}"
+        if self.faults:
+            replay += f" --faults '{self.faults}'"
+        if self.max_steps is not None and self.max_steps != _MAX_STEPS:
+            # a violation past the default step budget replays clean
+            # without the budget that reached it
+            replay += f" --max-steps {self.max_steps}"
+        return (
+            f"[{self.machine}] schedule {self.sid} step {self.step}: "
+            f"{self.invariant}\n"
+            f"  trace: {pre}{' -> '.join(tail)}\n"
+            f"  replay: {replay}"
+        )
+
+    def to_dict(self) -> dict:
+        out = {
+            "machine": self.machine, "sid": self.sid, "step": self.step,
+            "invariant": self.invariant, "trace": self.trace,
+        }
+        if self.plant:
+            out["plant"] = self.plant
+        if self.faults:
+            out["faults"] = self.faults
+        if self.max_steps is not None and self.max_steps != _MAX_STEPS:
+            out["max_steps"] = self.max_steps
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Violation":
+        """Round-trip of :meth:`to_dict` — the absent default flags
+        come back as None, which renders identically (omitted from the
+        replay command)."""
+        return cls(d["machine"], d["sid"], d["step"], d["invariant"],
+                   d["trace"], plant=d.get("plant"),
+                   faults=d.get("faults"), max_steps=d.get("max_steps"))
+
+
+# ---------------------------------------------------------------------------
+# election machine (model of bus/replication.py)
+# ---------------------------------------------------------------------------
+
+class _Replica:
+    __slots__ = ("index", "alive", "role", "term", "log", "coord")
+
+    def __init__(self, index: int):
+        self.index = index
+        self.alive = True
+        self.role = "follower"
+        self.term = 0  # persisted (set_term writes the WAL meta)
+        #: durable ordered log of write ids — the WAL survives crashes
+        self.log: List[int] = []
+        #: leader-side coordinator: follower index → acked log length;
+        #: reset on every promotion (the real coordinator is rebuilt)
+        self.coord: Dict[int, int] = {}
+
+
+class ElectionMachine:
+    """Model of the replication leader protocol: most-advanced-survivor
+    election with a reachable-majority floor, quorum-acked writes,
+    crash-stop faults with durable logs.  Mirrors ``_elect`` /
+    ``_lead_tick`` / the commit rule in ``bus/replication.py`` — the
+    ordering comparators and the quorum rule are the same expressions.
+    """
+
+    name = "election"
+    default_faults = "repl.drop=0.15;bus.leader_kill=0.15:count=2"
+
+    def __init__(self, replicas: int = 3, max_writes: int = 6,
+                 crash_budget: int = 3):
+        self.n = replicas
+        self.max_writes = max_writes
+        self.crash_budget_total = crash_budget
+
+    def reset(self, sched: Schedule, plane: FaultPlane,
+              plant: Optional[str]) -> None:
+        # the PRODUCTION quorum rule and ordering comparators — the
+        # model cannot drift from bus/replication.py
+        from volcano_tpu.bus.replication import (
+            candidate_rank, leader_rank, quorum_of,
+        )
+
+        self.sched = sched
+        self.plane = plane
+        self.plant = plant
+        self.quorum = quorum_of(self.n)
+        self._candidate_rank = candidate_rank
+        self._leader_rank = leader_rank
+        self.replicas = [_Replica(i) for i in range(self.n)]
+        self.replicas[0].role = "leader"
+        self.replicas[0].term = 1
+        self.acked: set = set()        # write ids acked to clients
+        self.writes = itertools.count(1)
+        self.n_writes = 0
+        self.crash_budget = self.crash_budget_total
+        #: plant state: candidate index → stale probe snapshot
+        self.stale_probe: Dict[int, List[Tuple[int, int, int]]] = {}
+
+    def teardown(self) -> None:
+        pass
+
+    # ---- helpers ----
+
+    def _leaders(self) -> List[_Replica]:
+        return [r for r in self.replicas if r.alive and r.role == "leader"]
+
+    def _commit_len(self, leader: _Replica) -> int:
+        # quorum-th highest held position across the WHOLE group — a
+        # follower that never acked holds position 0 (the real
+        # coordinator's rule; counting only acked followers would let a
+        # lone leader self-quorum)
+        held = sorted(
+            [len(leader.log)]
+            + [leader.coord.get(i, 0) for i in range(self.n)
+               if i != leader.index],
+            reverse=True,
+        )
+        return held[self.quorum - 1]
+
+    def _recompute_acks(self, leader: _Replica) -> None:
+        self.acked.update(leader.log[: self._commit_len(leader)])
+
+    def _promote(self, r: _Replica, term: int) -> None:
+        r.term = term
+        r.role = "leader"
+        r.coord = {}
+
+    # ---- actions ----
+
+    def actions(self) -> List[Tuple[str, Callable[[], None]]]:
+        acts: List[Tuple[str, Callable[[], None]]] = []
+        leaders = self._leaders()
+        leader = leaders[0] if leaders else None
+
+        if leader is not None and self.n_writes < self.max_writes:
+            acts.append(("write", self._act_write))
+        for f in self.replicas:
+            if f.alive and f.role == "follower":
+                for ld in leaders:
+                    acts.append((
+                        f"ship r{f.index}<-r{ld.index}",
+                        lambda f=f, ld=ld: self._act_ship(f, ld),
+                    ))
+        if self.crash_budget > 0:
+            for r in self.replicas:
+                if r.alive:
+                    acts.append((
+                        f"crash r{r.index}",
+                        lambda r=r: self._act_crash(r),
+                    ))
+        for r in self.replicas:
+            if not r.alive:
+                acts.append((
+                    f"restart r{r.index}", lambda r=r: self._act_restart(r)
+                ))
+        if not leaders:
+            for r in self.replicas:
+                if r.alive and r.index not in self.stale_probe:
+                    acts.append((
+                        f"elect r{r.index}", lambda r=r: self._act_elect(r)
+                    ))
+        if self.plant == "stale-election":
+            for idx in list(self.stale_probe):
+                r = self.replicas[idx]
+                if r.alive and r.role == "follower":
+                    acts.append((
+                        f"promote-stale r{idx}",
+                        lambda r=r: self._act_promote_stale(r),
+                    ))
+                else:
+                    del self.stale_probe[idx]
+        if len(leaders) > 1:
+            for r in leaders:
+                acts.append((
+                    f"lead-tick r{r.index}",
+                    lambda r=r: self._act_lead_tick(r),
+                ))
+        return acts
+
+    def _act_write(self) -> None:
+        leader = self._leaders()[0]
+        leader.log.append(next(self.writes))
+        self.n_writes += 1
+        self._recompute_acks(leader)
+
+    def _act_ship(self, f: _Replica, leader: _Replica) -> None:
+        if self.plane.should("repl.drop"):
+            return  # the shipment batch is dropped; the follower re-pulls
+        if f.log == leader.log[: len(f.log)]:
+            f.log.extend(leader.log[len(f.log):])
+        else:
+            # diverged history (a deposed leader's un-acked suffix):
+            # chain mismatch → snapshot resync, exactly the repl_append
+            # `snapshot_needed` path
+            f.log = list(leader.log)
+        if leader.term > f.term:
+            f.term = leader.term
+        leader.coord[f.index] = len(f.log)
+        self._recompute_acks(leader)
+
+    def _act_crash(self, r: _Replica) -> None:
+        r.alive = False
+        self.crash_budget -= 1
+        # term/log survive: the WAL is durable.  Leadership does not.
+        if r.role == "leader":
+            r.role = "follower"
+            r.coord = {}
+
+    def _act_restart(self, r: _Replica) -> None:
+        r.alive = True
+        r.role = "follower"
+
+    def _probe(self, r: _Replica) -> List[Tuple[int, int, int]]:
+        """``candidate_rank`` of every reachable live peer."""
+        return [
+            self._candidate_rank(p.term, len(p.log), p.index)
+            for p in self.replicas
+            if p.alive and p.index != r.index
+        ]
+
+    def _act_elect(self, r: _Replica) -> None:
+        """One atomic election attempt: probe + decide + promote.  The
+        real protocol's probe window is protected by the index stagger
+        and re-probe; the model collapses it to one action (the planted
+        ``stale-election`` variant splits it back open)."""
+        statuses = self._probe(r)
+        if self.plant == "stale-election":
+            self.stale_probe[r.index] = statuses
+            return
+        if self._leaders():
+            return  # an existing leader wins immediately: follow it
+        if len(statuses) + 1 < self.quorum:
+            return  # below the reachable-majority floor: refuse
+        mine = self._candidate_rank(r.term, len(r.log), r.index)
+        if any(peer > mine for peer in statuses):
+            return  # a more advanced peer exists; let it promote
+        max_term = max([r.term] + [t for t, _s, _i in statuses])
+        self._promote(r, max_term + 1)
+
+    def _act_promote_stale(self, r: _Replica) -> None:
+        """PLANTED BUG: decide on the snapshot taken at probe time.  A
+        peer that promoted since is invisible, so two candidates can
+        claim the same term."""
+        statuses = self.stale_probe.pop(r.index)
+        if len(statuses) + 1 < self.quorum:
+            return
+        mine = self._candidate_rank(r.term, len(r.log), r.index)
+        if any(peer > mine for peer in statuses):
+            return
+        max_term = max([r.term] + [t for t, _s, _i in statuses])
+        self._promote(r, max_term + 1)
+
+    def _act_lead_tick(self, r: _Replica) -> None:
+        """Same-term dual-leader resolution: the higher ``leader_rank``
+        stays, the other steps down — ``_lead_tick``'s rule."""
+        mine = self._leader_rank(r.term, self._commit_len(r), r.index)
+        for p in self._leaders():
+            if p is r:
+                continue
+            peer = self._leader_rank(p.term, self._commit_len(p), p.index)
+            if peer > mine:
+                r.role = "follower"
+                r.coord = {}
+                return
+
+    # ---- faults + invariants ----
+
+    def fire_faults(self) -> Optional[str]:
+        leaders = self._leaders()
+        if leaders and self.crash_budget > 0 and self.plane.should(
+            "bus.leader_kill"
+        ):
+            leader = leaders[0]
+            self._act_crash(leader)
+            return f"fault:bus.leader_kill r{leader.index}"
+        return None
+
+    def check(self) -> List[str]:
+        errs: List[str] = []
+        by_term: Dict[int, int] = {}
+        for r in self._leaders():
+            if r.term in by_term:
+                errs.append(
+                    f"two live leaders in term {r.term}: replicas "
+                    f"r{by_term[r.term]} and r{r.index}"
+                )
+            else:
+                by_term[r.term] = r.index
+        for leader in self._leaders():
+            lost = self.acked - set(leader.log)
+            if lost:
+                errs.append(
+                    f"acked-then-lost: writes {sorted(lost)} were acked "
+                    f"to clients but are missing from live leader "
+                    f"r{leader.index}'s log"
+                )
+        return errs
+
+
+# ---------------------------------------------------------------------------
+# lease machine (drives the REAL ShardLeaseManager._tick)
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    """Stand-in for the ``time`` module inside ``federation.leases`` —
+    wall and monotonic advance in lockstep under schedule control."""
+
+    def __init__(self, start: float = 1000.0):
+        self.now = start
+
+    def time(self) -> float:
+        return self.now
+
+    def monotonic(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+class LeaseMachine:
+    """The real CAS-lease protocol under permuted tick order, clock
+    advances, CAS failures and member crashes."""
+
+    name = "lease"
+    default_faults = "lease.cas_fail=0.1"
+
+    def __init__(self, members: int = 3, n_shards: int = 4,
+                 crash_budget: int = 2):
+        self.n_members = members
+        self.n_shards = n_shards
+        self.crash_budget_total = crash_budget
+
+    def reset(self, sched: Schedule, plane: FaultPlane,
+              plant: Optional[str]) -> None:
+        from volcano_tpu.client.apiserver import APIServer, ConflictError
+        from volcano_tpu.federation import leases as leases_mod
+
+        self.sched = sched
+        self.plane = plane
+        self.plant = plant
+        self._leases_mod = leases_mod
+        self._orig_time = leases_mod.time
+        # __dict__ access keeps the staticmethod wrapper — plain
+        # attribute access unwraps it and the restore would re-bind self
+        self._orig_expired = leases_mod.ShardLeaseManager.__dict__["_expired"]
+        self.clock = _FakeClock()
+        leases_mod.time = self.clock  # type: ignore[assignment]
+        if plant == "lease-steal":
+            # PLANTED BUG: every lease reads as expired at claim time —
+            # a member steals slices its peers still validly hold
+            leases_mod.ShardLeaseManager._expired = staticmethod(
+                lambda entry, now: True
+            )
+        self.api = APIServer()
+        orig_cas = self.api.compare_and_update
+
+        def cas_with_fault(obj, expected_rv):
+            if plane.should("lease.cas_fail"):
+                raise ConflictError(
+                    "injected lease.cas_fail: CAS lost this tick"
+                )
+            return orig_cas(obj, expected_rv)
+
+        self.api.compare_and_update = cas_with_fault
+        self.lease_duration = 2.0
+        self.mgrs: Dict[str, leases_mod.ShardLeaseManager] = {}
+        self.live: set = set()
+        for i in range(self.n_members):
+            self._spawn(f"m{i}")
+        self.crash_budget = self.crash_budget_total
+
+    def _spawn(self, ident: str) -> None:
+        self.mgrs[ident] = self._leases_mod.ShardLeaseManager(
+            self.api, ident, n_shards=self.n_shards,
+            lease_duration=self.lease_duration, retry_period=0.2,
+        )
+        # a fresh manager has never renewed; seed validity bookkeeping
+        self.mgrs[ident]._last_renew = -self.lease_duration * 10
+        self.live.add(ident)
+
+    def teardown(self) -> None:
+        if not hasattr(self, "_leases_mod"):
+            return  # reset failed before saving/patching anything
+        self._leases_mod.time = self._orig_time
+        setattr(self._leases_mod.ShardLeaseManager, "_expired",
+                self._orig_expired)
+
+    # ---- actions ----
+
+    def actions(self) -> List[Tuple[str, Callable[[], None]]]:
+        from volcano_tpu.client.apiserver import ApiError
+
+        acts: List[Tuple[str, Callable[[], None]]] = []
+
+        def tick(ident: str) -> None:
+            mgr = self.mgrs[ident]
+            try:
+                mgr._tick()
+            except ApiError:
+                mgr._maybe_expire()  # the run() loop's degraded path
+
+        for ident in sorted(self.live):
+            acts.append((f"tick {ident}", lambda i=ident: tick(i)))
+        acts.append((
+            "advance 0.3", lambda: self.clock.advance(0.3)
+        ))
+        acts.append((
+            f"advance {self.lease_duration + 0.1:g}",
+            lambda: self.clock.advance(self.lease_duration + 0.1),
+        ))
+        if self.crash_budget > 0 and len(self.live) > 1:
+            for ident in sorted(self.live):
+                acts.append((
+                    f"crash {ident}", lambda i=ident: self._act_crash(i)
+                ))
+        for ident in sorted(set(self.mgrs) - self.live):
+            acts.append((
+                f"restart {ident}", lambda i=ident: self._spawn(i)
+            ))
+        return acts
+
+    def _act_crash(self, ident: str) -> None:
+        self.live.discard(ident)
+        self.crash_budget -= 1
+
+    def fire_faults(self) -> Optional[str]:
+        return None  # lease.cas_fail fires inside the CAS write path
+
+    def check(self) -> List[str]:
+        owned: Dict[int, str] = {}
+        errs: List[str] = []
+        for ident in sorted(self.live):
+            mgr = self.mgrs[ident]
+            valid = (
+                self.clock.monotonic() - mgr._last_renew
+                <= self.lease_duration
+            )
+            if not valid:
+                continue  # self-expiry window: not an owner any more
+            for shard in sorted(mgr._applied):
+                if shard in owned:
+                    errs.append(
+                        f"shard {shard} doubly owned by {owned[shard]} "
+                        f"and {ident} (both within renewal validity)"
+                    )
+                else:
+                    owned[shard] = ident
+        return errs
+
+
+# ---------------------------------------------------------------------------
+# gang machine (drives the REAL APIServer.txn_commit)
+# ---------------------------------------------------------------------------
+
+class GangMachine:
+    """Two racing assembly planners committing one gang through the real
+    ``txn_commit``, with stale-claim injection (competing resource-
+    version bumps) and mid-assembly crashes."""
+
+    name = "gang"
+    default_faults = "gang.kill_mid_assembly=0.15:count=1"
+
+    def __init__(self, size: int = 4, touch_budget: int = 3):
+        self.size = size
+        self.min_member = size
+        self.touch_budget_total = touch_budget
+
+    def reset(self, sched: Schedule, plane: FaultPlane,
+              plant: Optional[str]) -> None:
+        from volcano_tpu.apis import core
+        from volcano_tpu.client.apiserver import APIServer
+
+        self.sched = sched
+        self.plane = plane
+        self.plant = plant
+        self.api = APIServer()
+        self.ns = "default"
+        self.pods = [f"gang-{i}" for i in range(self.size)]
+        for name in self.pods:
+            self.api.create(core.Pod(
+                metadata=core.ObjectMeta(name=name, namespace=self.ns),
+                spec=core.PodSpec(containers=[]),
+                status=core.PodStatus(phase="Pending"),
+            ))
+        #: planner → claim list (plan-time resource versions) or None
+        self.plans: Dict[str, Optional[List[dict]]] = {"A": None, "B": None}
+        self.crashed: set = set()
+        self.touch_budget = self.touch_budget_total
+        self.done = False
+
+    def teardown(self) -> None:
+        pass
+
+    # ---- actions ----
+
+    def actions(self) -> List[Tuple[str, Callable[[], None]]]:
+        acts: List[Tuple[str, Callable[[], None]]] = []
+        if self.done:
+            return acts
+        for planner in ("A", "B"):
+            if planner in self.crashed:
+                continue
+            if self.plans[planner] is None:
+                acts.append((
+                    f"plan {planner}",
+                    lambda p=planner: self._act_plan(p),
+                ))
+            else:
+                acts.append((
+                    f"commit {planner}",
+                    lambda p=planner: self._act_commit(p),
+                ))
+                acts.append((
+                    f"crash {planner}",
+                    lambda p=planner: self._act_crash(p),
+                ))
+        if self.touch_budget > 0:
+            for i, name in enumerate(self.pods):
+                acts.append((
+                    f"touch {name}",
+                    lambda n=name: self._act_touch(n),
+                ))
+        return acts
+
+    def _act_plan(self, planner: str) -> None:
+        """Snapshot claims at current store truth — the broker's
+        plan_gang_assembly read, resource versions included."""
+        claims = []
+        for i, name in enumerate(self.pods):
+            pod = self.api.get("Pod", self.ns, name)
+            if pod is None or pod.spec.node_name:
+                self.plans[planner] = None
+                return  # gang already (partly) bound: planner defers
+            claims.append({
+                "namespace": self.ns, "name": name,
+                "hostname": f"node-{planner.lower()}{i % 2}",
+                "expected_rv": pod.metadata.resource_version,
+            })
+        self.plans[planner] = claims
+
+    def _act_commit(self, planner: str) -> None:
+        from volcano_tpu.client.apiserver import ApiError
+
+        plan = self.plans[planner]
+        self.plans[planner] = None
+        if plan is None:
+            return
+        if self.plane.should("gang.kill_mid_assembly"):
+            # the planner dies between planning and committing: the
+            # orphaned assembly is discarded whole, nothing landed
+            self.crashed.add(planner)
+            return
+        if self.plant == "partial-commit":
+            # PLANTED BUG: replay the gang as per-member cas_binds,
+            # ignoring per-item conflicts — the replay the VBUS
+            # old-peer fallback exists to forbid
+            for b in plan:
+                try:
+                    self.api.cas_bind(
+                        b["namespace"], b["name"], b["hostname"],
+                        expected_rv=b["expected_rv"],
+                    )
+                except ApiError:
+                    continue
+            self.done = True
+            return
+        resp = self.api.txn_commit(plan)
+        if resp["committed"]:
+            self.done = True
+        # abort: discard-until-stable — the planner re-plans from
+        # fresh truth on a later step
+
+    def _act_crash(self, planner: str) -> None:
+        self.plans[planner] = None
+        self.crashed.add(planner)
+
+    def _act_touch(self, name: str) -> None:
+        """Bump one member's resourceVersion (a status write from a
+        controller) — every plan holding the old rv is now stale."""
+        pod = self.api.get("Pod", self.ns, name)
+        if pod is None:
+            return
+        clone = pod.clone()
+        clone.metadata.annotations = dict(clone.metadata.annotations or {})
+        clone.metadata.annotations["touched"] = str(
+            self.touch_budget_total - self.touch_budget
+        )
+        self.api.update_status(clone)
+        self.touch_budget -= 1
+
+    def fire_faults(self) -> Optional[str]:
+        return None  # gang.kill_mid_assembly fires inside commit
+
+    def check(self) -> List[str]:
+        bound = sum(
+            1 for name in self.pods
+            if (pod := self.api.get("Pod", self.ns, name)) is not None
+            and pod.spec.node_name
+        )
+        if 0 < bound < self.min_member:
+            return [
+                f"partial gang: {bound}/{self.size} members bound "
+                f"(minMember={self.min_member}) — observable below "
+                f"minMember"
+            ]
+        return []
+
+
+MACHINES: Dict[str, Callable[[], object]] = {
+    "election": ElectionMachine,
+    "lease": LeaseMachine,
+    "gang": GangMachine,
+}
+
+
+# ---------------------------------------------------------------------------
+# the explorer loop
+# ---------------------------------------------------------------------------
+
+def run_schedule(machine, sid: int, max_steps: int = _MAX_STEPS,
+                 plant: Optional[str] = None,
+                 faults: Optional[str] = None,
+                 trace_out=None) -> Tuple[Optional[Violation], int]:
+    """Run one schedule; returns ``(violation_or_None, steps_taken)``.
+    Deterministic: the same ``(machine, sid, plant, faults)`` replays
+    the same trace bit-for-bit."""
+    sched = Schedule(sid)
+    spec = faults if faults is not None else machine.default_faults
+    plane = FaultPlane(parse_faults(f"seed={sid};{spec}" if spec
+                                    else f"seed={sid}"))
+    trace: List[str] = []
+    try:
+        # inside the try: LeaseMachine.reset patches process globals
+        # (module clock, _expired) before it constructs the apiserver
+        # and managers — a failure mid-reset must still restore them
+        machine.reset(sched, plane, plant)
+        for step in range(max_steps):
+            fault_label = machine.fire_faults()
+            if fault_label is not None:
+                trace.append(fault_label)
+                if trace_out is not None:
+                    print(f"  {step:3d}  {fault_label}", file=trace_out)
+            acts = machine.actions()
+            if not acts:
+                break
+            label, fn = acts[sched.choose(len(acts))]
+            trace.append(label)
+            if trace_out is not None:
+                print(f"  {step:3d}  {label}", file=trace_out)
+            fn()
+            errs = machine.check()
+            if errs:
+                return Violation(
+                    machine.name, sid, step, "; ".join(errs), trace,
+                    plant=plant, faults=faults, max_steps=max_steps,
+                ), step + 1
+        return None, len(trace)
+    finally:
+        machine.teardown()
+
+
+def explore(machine_names, schedules: int, max_steps: int = _MAX_STEPS,
+            plant: Optional[str] = None, faults: Optional[str] = None,
+            seed_base: int = 0, max_violations: int = 5) -> dict:
+    """Run ``schedules`` distinct schedules per named machine."""
+    out: Dict[str, dict] = {}
+    for name in machine_names:
+        machine = MACHINES[name]()
+        violations: List[Violation] = []
+        steps = 0
+        ran = 0
+        for sid in range(seed_base, seed_base + schedules):
+            v, n = run_schedule(machine, sid, max_steps=max_steps,
+                                plant=plant, faults=faults)
+            steps += n
+            ran += 1
+            if v is not None:
+                violations.append(v)
+                if len(violations) >= max_violations:
+                    break
+        out[name] = {
+            # schedules actually RUN, not requested: the loop stops at
+            # max_violations, and the CI report must not attest to
+            # coverage that never executed.  Everything here is plain
+            # JSON — callers may json.dump the result directly
+            "schedules": ran,
+            "steps": steps,
+            "violations": [v.to_dict() for v in violations],
+        }
+    return out
+
+
+def main(argv: Optional[List[str]] = None, out=None) -> int:
+    out = out or sys.stdout
+    parser = argparse.ArgumentParser(
+        prog="vtctl explore",
+        description="deterministic interleaving explorer for the "
+                    "election / lease / gang-assembly protocols",
+    )
+    parser.add_argument("--schedules", type=int, default=None,
+                        help="schedules per machine (default 500; "
+                             "--quick: 100)")
+    parser.add_argument("--quick", action="store_true",
+                        help="CI budget: 100 schedules per machine")
+    parser.add_argument("--machine", action="append",
+                        choices=sorted(MACHINES),
+                        help="explore only this machine (repeatable; "
+                             "default: all)")
+    parser.add_argument("--max-steps", type=int, default=_MAX_STEPS,
+                        help="actions per schedule (default "
+                             f"{_MAX_STEPS}; Violation.render omits "
+                             "the flag from replay commands at this "
+                             "default, so the two must not drift)")
+    parser.add_argument("--seed-base", type=int, default=0,
+                        help="first schedule seed (default 0)")
+    parser.add_argument("--plant", choices=PLANTS,
+                        help="plant a known protocol bug (the detection "
+                             "self-test; the run must FAIL)")
+    parser.add_argument("--faults", default=None,
+                        help="faults-plane spec overriding each "
+                             "machine's default (same grammar as "
+                             "VTPU_FAULTS; the seed clause is supplied "
+                             "per schedule)")
+    parser.add_argument("--replay", metavar="MACHINE:SEED",
+                        help="re-run one schedule, printing its trace")
+    parser.add_argument("--report", default=None,
+                        help="write a JSON report here")
+    parser.add_argument("--verbose", action="store_true",
+                        help="keep the protocols' own INFO logging")
+    args = parser.parse_args(argv)
+
+    if not args.verbose:
+        # thousands of schedules re-run the real lease/gang code paths;
+        # their own INFO logging would drown the summary.  Scoped: main
+        # is callable in-process (vtctl tests), so the level is
+        # restored on the way out
+        import logging
+
+        # the package logger must be CONFIGURED before we override it:
+        # the machines lazily import modules that pull in
+        # volcano_tpu.utils.logging, whose first-import body sets the
+        # package level to INFO — importing it after setLevel would
+        # clobber the CRITICAL override (and the restore would write
+        # back the pre-configuration NOTSET)
+        import volcano_tpu.utils.logging  # noqa: F401
+
+        logger = logging.getLogger("volcano_tpu")
+        prev_level = logger.level
+        logger.setLevel(logging.CRITICAL)
+        try:
+            return _run(args, out)
+        finally:
+            logger.setLevel(prev_level)
+    return _run(args, out)
+
+
+def _run(args, out) -> int:
+    if args.replay:
+        name, _, sid_s = args.replay.partition(":")
+        if name not in MACHINES or not sid_s.lstrip("-").isdigit():
+            print(f"--replay wants <machine>:<seed>, got {args.replay!r}",
+                  file=out)
+            return 2
+        machine = MACHINES[name]()
+        print(f"replaying {name} schedule {sid_s}:", file=out)
+        v, steps = run_schedule(
+            machine, int(sid_s), max_steps=args.max_steps,
+            plant=args.plant, faults=args.faults, trace_out=out,
+        )
+        if v is not None:
+            print(v.render(), file=out)
+            return 1
+        print(f"schedule {sid_s}: {steps} steps, invariants held",
+              file=out)
+        return 0
+
+    schedules = (args.schedules if args.schedules is not None
+                 else (100 if args.quick else 500))
+    machines = args.machine or sorted(MACHINES)
+    results = explore(
+        machines, schedules, max_steps=args.max_steps,
+        plant=args.plant, faults=args.faults, seed_base=args.seed_base,
+    )
+    failed = False
+    total = 0
+    for name in machines:
+        r = results[name]
+        total += r["schedules"]
+        print(
+            f"{name}: {r['schedules']} schedules, {r['steps']} steps, "
+            f"{len(r['violations'])} violation(s)", file=out,
+        )
+        for vd in r["violations"]:
+            print(Violation.from_dict(vd).render(), file=out)
+            failed = True
+    print(f"explore: {total} schedules total across "
+          f"{len(machines)} machine(s)", file=out)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(results, f, indent=2)
+            f.write("\n")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

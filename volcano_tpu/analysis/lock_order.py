@@ -184,6 +184,21 @@ class _Graph:
 
 _graph: Optional[_Graph] = None
 
+#: optional sync-event listener (the happens-before race detector in
+#: :mod:`~volcano_tpu.analysis.race` registers here): notified on every
+#: acquire/release of an instrumented lock so vector clocks can ride
+#: the SAME proxies the lock-order verifier installs.  ``released`` is
+#: called BEFORE the inner lock is released (the lock's clock must be
+#: published while the releasing thread still holds it) and
+#: ``acquired`` after the inner acquire returns (the thread joins the
+#: clock only once it owns the lock).
+_listener = None
+
+
+def set_listener(listener) -> None:
+    global _listener
+    _listener = listener
+
 
 class _InstrumentedLock:
     """Proxy over a real Lock/RLock recording acquire/release order.
@@ -202,11 +217,18 @@ class _InstrumentedLock:
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         got = self._inner.acquire(blocking, timeout)
-        if got and _graph is not None:
-            _graph.acquired(self._id)
+        if got:
+            if _graph is not None:
+                _graph.acquired(self._id)
+            if _listener is not None:
+                _listener.lock_acquired(self._id)
         return got
 
     def release(self) -> None:
+        if _listener is not None:
+            # before the inner release: the clock must be on the lock
+            # while this thread still owns it
+            _listener.lock_released(self._id)
         self._inner.release()
         if _graph is not None:
             _graph.released(self._id)
@@ -225,6 +247,8 @@ class _InstrumentedLock:
     # ---- Condition protocol ----
 
     def _release_save(self):
+        if _listener is not None:
+            _listener.lock_released(self._id)
         state = self._inner._release_save() if hasattr(
             self._inner, "_release_save"
         ) else (self._inner.release() or None)
@@ -241,6 +265,8 @@ class _InstrumentedLock:
             self._inner.acquire()
         if _graph is not None:
             _graph.acquired(self._id, count=count)
+        if _listener is not None:
+            _listener.lock_acquired(self._id)
 
     def _is_owned(self) -> bool:
         if hasattr(self._inner, "_is_owned"):

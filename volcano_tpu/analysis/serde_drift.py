@@ -20,6 +20,12 @@ Four invariants over the bus protocol surface:
 * ``SRD004`` — an op the client sends that the server does not handle
   (or vice versa: a registered op nobody dispatches) is drift between
   the two halves of the protocol.
+* ``SRD005`` — the README's VBUS version-ladder paragraph must declare
+  the CURRENT protocol version (``max(OP_VERSIONS.values())``) and
+  name every registered op.  PR 11 caught the ladder still reading
+  "version 3" three versions late — by hand; this makes the doc-drift
+  machine-checked.  Judged only when README.md exists (a repo
+  checkout), like SRD001.
 
 This pass imports ``volcano_tpu.bus.protocol`` (our own package — the
 registries are the source of truth) and parses ``server.py`` /
@@ -39,11 +45,16 @@ CODE_NO_ROUNDTRIP = "SRD001"
 CODE_UNREGISTERED_OP = "SRD002"
 CODE_UNGATED_OP = "SRD003"
 CODE_OP_DRIFT = "SRD004"
+CODE_DOC_DRIFT = "SRD005"
 
 _PROTO = "volcano_tpu/bus/protocol.py"
 _SERVER = "volcano_tpu/bus/server.py"
 _REMOTE = "volcano_tpu/bus/remote.py"
 _TESTS = "tests/test_bus.py"
+_README = "README.md"
+
+#: the README version-ladder paragraph opens with this phrase
+_LADDER_RE = r"wire protocol is at \*\*VBUS version (\d+)\*\*"
 
 
 def _load(root: str, rel: str) -> Optional[SourceFile]:
@@ -192,5 +203,63 @@ def run(root: str) -> List[Finding]:
             PASS, CODE_OP_DRIFT, _PROTO, 1, op,
             f"protocol.OP_VERSIONS declares op `{op}` that bus/server.py "
             f"_execute never dispatches",
+        ))
+
+    # ---- SRD005: README version ladder tracks OP_VERSIONS ----
+    readme_path = os.path.join(root, _README)
+    if os.path.exists(readme_path):
+        with open(readme_path, encoding="utf-8") as f:
+            readme = f.read()
+        findings.extend(_check_ladder(readme, op_versions))
+    return findings
+
+
+def _check_ladder(readme: str, op_versions) -> List[Finding]:
+    """The VBUS version-ladder paragraph (located by its "wire protocol
+    is at **VBUS version N**" opener, ending at the next heading) must
+    declare ``max(OP_VERSIONS.values())`` and mention every registered
+    op as a backticked token."""
+    import re
+
+    findings: List[Finding] = []
+    current = max(op_versions.values())
+    m = re.search(_LADDER_RE, readme)
+    if m is None:
+        return [Finding(
+            PASS, CODE_DOC_DRIFT, _README, 1, "version-ladder",
+            "README has no VBUS version-ladder paragraph (expected "
+            "'wire protocol is at **VBUS version N**') — the protocol "
+            "surface must be documented",
+        )]
+    lineno = readme.count("\n", 0, m.start()) + 1
+    declared = int(m.group(1))
+    if declared != current:
+        findings.append(Finding(
+            PASS, CODE_DOC_DRIFT, _README, lineno, "version-ladder",
+            f"README declares VBUS version {declared} but "
+            f"protocol.OP_VERSIONS tops out at v{current} — the stale "
+            f"ladder paragraph again",
+        ))
+    # the section runs to the next markdown HEADING ("# " .. "###### ")
+    # outside a code fence — a bare "\n#" search would truncate at a
+    # `# comment` line inside a fenced shell example
+    section_end = None
+    in_fence = False
+    pos = m.end()
+    for line_m in re.finditer(r"^(.*)$", readme[pos:], re.MULTILINE):
+        line = line_m.group(1)
+        if line.lstrip().startswith("```"):
+            in_fence = not in_fence
+        elif not in_fence and re.match(r"#{1,6} ", line):
+            section_end = pos + line_m.start()
+            break
+    section = readme[m.start(): section_end]
+    mentioned = set(re.findall(r"`([a-z0-9_]+)`", section))
+    for op in sorted(set(op_versions) - mentioned):
+        findings.append(Finding(
+            PASS, CODE_DOC_DRIFT, _README, lineno, op,
+            f"op `{op}` (v{op_versions[op]}) is registered in "
+            f"protocol.OP_VERSIONS but the README version-ladder "
+            f"paragraph never names it",
         ))
     return findings

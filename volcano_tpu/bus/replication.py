@@ -83,6 +83,24 @@ def quorum_of(replica_count: int) -> int:
     return replica_count // 2 + 1 if replica_count >= 2 else 1
 
 
+def candidate_rank(term: int, seq: int, index: int):
+    """Election ordering: most advanced by (term, applied seq), lowest
+    index on ties.  A named function rather than an inline tuple so the
+    interleaving explorer's election model (analysis/explore.py) ranks
+    with the PRODUCTION comparator — the model cannot drift from the
+    implementation."""
+    return (term, seq, -index)
+
+
+def leader_rank(term: int, commit_seq: int, index: int):
+    """Dual-leader resolution ordering: a higher term always wins; an
+    EQUAL term resolves by COMMIT seq first (only one same-term leader
+    can hold a quorum, and deposing it by mere index would erase
+    majority-committed writes — the rolling-kill soak's catch), index
+    second.  Shared with the explorer like :func:`candidate_rank`."""
+    return (term, commit_seq, -index)
+
+
 class ReplicationCoordinator:
     """Leader-side record outbox + quorum tracking.
 
@@ -557,17 +575,17 @@ class ReplicaManager:
         with self._lock:
             coord = self.coordinator
         my_commit = coord.commit_seq() if coord is not None else 0
-        mine = (self.store.term, my_commit, -self.index)
+        mine = leader_rank(self.store.term, my_commit, self.index)
         for i, url in enumerate(self.endpoints):
             if i == self.index:
                 continue
             st = probe_status(url)
             if st is None or st.get("role") != "leader":
                 continue
-            peer = (
+            peer = leader_rank(
                 int(st.get("term", 0)),
                 int(st.get("commit_seq", 0)),
-                -int(st.get("index", len(self.endpoints))),
+                int(st.get("index", len(self.endpoints))),
             )
             if peer > mine:
                 log.error(
@@ -652,8 +670,10 @@ class ReplicaManager:
         # so a racing dual-leadership resolves to the same winner from
         # every observer's seat)
         leaders = [
-            (int(st.get("term", 0)), int(st.get("commit_seq", 0)),
-             -int(st.get("index", len(self.endpoints))), url)
+            leader_rank(
+                int(st.get("term", 0)), int(st.get("commit_seq", 0)),
+                int(st.get("index", len(self.endpoints))),
+            ) + (url,)
             for url, st in statuses.items() if st.get("role") == "leader"
         ]
         if leaders:
@@ -667,11 +687,14 @@ class ReplicaManager:
                 self.replica_count,
             )
             return None
-        mine = (self.store.term, self.store.event_seq, -self.index)
+        mine = candidate_rank(self.store.term, self.store.event_seq,
+                              self.index)
         best_peer = max(
             (
-                (int(st.get("term", 0)), int(st.get("seq", 0)),
-                 -int(st.get("index", len(self.endpoints))))
+                candidate_rank(
+                    int(st.get("term", 0)), int(st.get("seq", 0)),
+                    int(st.get("index", len(self.endpoints))),
+                )
                 for st in statuses.values()
             ),
             default=None,

@@ -1030,6 +1030,17 @@ def build_parser() -> argparse.ArgumentParser:
     lint.set_defaults(cmd=None)
     lint.add_argument("lint_args", nargs=argparse.REMAINDER)
 
+    explore = sub.add_parser(
+        "explore",
+        description="deterministic interleaving explorer for the "
+        "election / lease / gang-assembly protocols "
+        "(volcano_tpu.analysis.explore); extra arguments are "
+        "forwarded, e.g. `vtctl explore --quick` or "
+        "`vtctl explore --replay election:71`",
+    )
+    explore.set_defaults(cmd=None)
+    explore.add_argument("explore_args", nargs=argparse.REMAINDER)
+
     return parser
 
 
@@ -1081,6 +1092,12 @@ def main(argv: Optional[List[str]] = None, api: Optional[APIServer] = None, out=
             from volcano_tpu.analysis.__main__ import main as lint_main
 
             return lint_main(raw[i + 1:], out=out)
+        if tok == "explore":
+            # same shape as lint: in-process protocol exploration — no
+            # store, no bus — with flags forwarded verbatim
+            from volcano_tpu.analysis.explore import main as explore_main
+
+            return explore_main(raw[i + 1:], out=out)
         break  # any other first positional/option: normal dispatch
     args = build_parser().parse_args(argv)
     remote = None

@@ -199,12 +199,19 @@ class FaultPlane:
 
         self.spec = spec
         self._lock = threading.Lock()
-        self._points: Dict[str, _PointState] = {}  # guarded-by: self._lock
-        for point in spec.rules:
-            # crc32 keeps the per-point seed stable across runs and
-            # Python processes (hash() is salted per-process)
-            derived = spec.seed ^ zlib.crc32(point.encode())
-            self._points[point] = _PointState(random.Random(derived))
+        # populated under the lock: a plane installed by configure()
+        # while another thread's get_plane() already returned it (the
+        # fast path reads _plane unlocked) must publish the dict through
+        # the same lock should() reads it under — unsynchronized
+        # construction was the first real race the happens-before
+        # detector caught
+        with self._lock:
+            self._points: Dict[str, _PointState] = {}  # guarded-by: self._lock
+            for point in spec.rules:
+                # crc32 keeps the per-point seed stable across runs and
+                # Python processes (hash() is salted per-process)
+                derived = spec.seed ^ zlib.crc32(point.encode())
+                self._points[point] = _PointState(random.Random(derived))
 
     def should(self, point: str) -> bool:
         """Evaluate ``point``; True = the seam must inject its fault.

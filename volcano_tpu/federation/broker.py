@@ -129,6 +129,12 @@ class GangBroker:
         self.assemble_after = assemble_after
         self.max_gangs_per_cycle = max_gangs_per_cycle
         self.kill_hook = kill_hook
+        # thread confinement (the PR 13 guarded-by sweep): everything
+        # below except _counters is touched ONLY by the scheduler
+        # thread's post_cycle pass (never reentered), so it carries no
+        # `# guarded-by:` — declaring a lock it doesn't take would lie
+        # to both the lexical pass and the runtime race detector.  The
+        # one cross-thread reader is counters(), served under _ctr_lock.
         #: permanently parked: the bus reported txn_commit unsupported
         #: (pre-v6 peer) — the honest refusal mode (scheduler-thread
         #: state; post_cycle is never reentered)

@@ -40,7 +40,11 @@ import json
 import math
 import random
 import threading
-import time
+import time  # explore-seam: the interleaving explorer swaps THIS
+# module attribute for a controlled clock and drives _tick() directly —
+# keep clock reads module-qualified (`time.time()`/`time.monotonic()`),
+# never `from time import ...`, and keep _tick free of real sleeps or
+# spawned threads, or the lease machine's schedules stop replaying
 import zlib
 from typing import Callable, Dict, List, Optional
 

@@ -80,11 +80,19 @@ class SpanExporter:
         self.flush_interval = flush_interval
         self.sample = _env_sample() if sample is None else sample
         self._lock = threading.Lock()
-        self._ring: deque = deque()  # guarded-by: self._lock
-        self._seq = 0  # guarded-by: self._lock
-        #: observability for tests; the metric is the operator surface
-        self.dropped = 0  # guarded-by: self._lock
-        self.exported = 0  # guarded-by: self._lock
+        # populated under the lock: enable() publishes the exporter
+        # through the unsynchronized _spans._set_exporter global, so a
+        # thread alive before enable() first sees this state through
+        # its own emit()-side lock acquire — construction must publish
+        # through the same lock (the FaultPlane._points lesson, caught
+        # by the happens-before detector)
+        with self._lock:
+            self._ring: deque = deque()  # guarded-by: self._lock
+            self._seq = 0  # guarded-by: self._lock
+            #: observability for tests; the metric is the operator
+            #: surface
+            self.dropped = 0  # guarded-by: self._lock
+            self.exported = 0  # guarded-by: self._lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

@@ -77,7 +77,14 @@ def configure(socket_path: Optional[str]) -> None:
     """Point the executors at a sidecar.  ``None`` explicitly DISABLES
     the remote path — including a VTPU_COMPUTE_PLANE env setting."""
     global _remote
+    old = _remote
     _remote = _Remote(socket_path) if socket_path else None
+    if isinstance(old, _Remote):
+        # the replaced route's connection must close NOW, not at gc: a
+        # live healthy client holds both ends of the sidecar socket
+        # open (fd-leak-guard catch), and captured log records can pin
+        # the abandoned object past interpreter cleanup
+        old.client.close()
 
 
 def _get_remote() -> Optional[_Remote]:
