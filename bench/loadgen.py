@@ -213,6 +213,14 @@ class LoadgenTopology:
         self.bus.stop()
 
 
+def _free_port() -> int:
+    import socket as _socket
+
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 class FederatedTopology(LoadgenTopology):
     """The sharded federation under load, topology fully real: the same
     in-process store + TCP bus + audit watch, but scheduling is done by
@@ -228,15 +236,23 @@ class FederatedTopology(LoadgenTopology):
                  lease_duration: float = 2.0,
                  micro_cycles: bool = True,
                  startup_timeout: float = 180.0,
-                 log_dir: str = ""):
+                 log_dir: str = "",
+                 n_members: int = 0,
+                 extra_flags=()):
         import subprocess
 
         self._init_store(n_nodes, node_cpu)
         self.n_shards = n_shards
+        #: with ``n_members > n_shards`` the extra schedulers run as
+        #: warm STANDBYS: registered members that hold no slice until
+        #: the map grows (fair share hands them nothing) — the ramp
+        #: drill's pre-provisioned pool, so the rebalance gate measures
+        #: the lease plane, not Python process startup
+        self.n_members = n_members or n_shards
         self.procs = []
         self._logs = []
         url = f"tcp://127.0.0.1:{self.bus.port}"
-        for i in range(n_shards):
+        for i in range(self.n_members):
             cmd = [
                 sys.executable, "-m", "volcano_tpu.cmd.scheduler",
                 "--bus", url,
@@ -248,6 +264,7 @@ class FederatedTopology(LoadgenTopology):
                 "--pipelined-commit", "--snapshot-reuse",
                 "--scheduler-conf", conf_path,
                 "--listen-port", "0",
+                *extra_flags,
             ]
             if micro_cycles:
                 cmd.append("--micro-cycles")
@@ -281,7 +298,7 @@ class FederatedTopology(LoadgenTopology):
                 }
                 if "" not in holders and None not in holders and len(
                     rec.get("members", {})
-                ) >= self.n_shards:
+                ) >= self.n_members:
                     return
             time.sleep(0.1)
         raise RuntimeError(
@@ -330,6 +347,78 @@ class FederatedTopology(LoadgenTopology):
         self.bus.stop()
 
 
+class _ScaleWatcher(threading.Thread):
+    """Ramp-drill observer: polls the shard map, records every shard-
+    count change the autoscaler commits, and stamps how long the fleet
+    took to REBALANCE after it (every slice of the new partition held
+    by an unexpired lease) — the `rebalance within K lease TTLs` gate's
+    measurement, taken from store truth off the measured path."""
+
+    def __init__(self, api, lease_duration: float):
+        super().__init__(name="loadgen-scale-watcher", daemon=True)
+        self.api = api
+        self.lease_duration = lease_duration
+        # NOT `_stop`: threading.Thread uses a private `_stop()` METHOD
+        # internally (tstate-lock cleanup) — shadowing it with an Event
+        # crashes join()
+        self._stop_evt = threading.Event()
+        self._lock = threading.Lock()
+        #: committed scale events: {"from", "target", "direction",
+        #: "reason", "rebalance_s" (None until every slice is held)}
+        self.events = []  # guarded-by: self._lock
+
+    def run(self) -> None:
+        from volcano_tpu.client.apiserver import ApiError
+        from volcano_tpu.federation import read_shard_map
+
+        last_n = None
+        pending = []  # [t0, event] awaiting full coverage
+        while not self._stop_evt.wait(0.05):
+            try:
+                rec = read_shard_map(self.api)
+            except ApiError:
+                continue
+            if rec is None:
+                continue
+            n = int(rec.get("nShards", 0) or 0)
+            if last_n is None:
+                last_n = n
+            elif n != last_n:
+                blob = rec.get("autoscale", {}) or {}
+                event = {
+                    "from": last_n, "target": n,
+                    "direction": blob.get("direction", "?"),
+                    "reason": blob.get("reason", ""),
+                    "rebalance_s": None,
+                }
+                with self._lock:
+                    self.events.append(event)
+                pending.append([time.monotonic(), event])
+                last_n = n
+            if pending:
+                now_wall = time.time()
+                covered = all(
+                    e.get("holder")
+                    and now_wall - float(e.get("renewTime", 0.0))
+                    <= float(e.get("leaseDurationSeconds", 0.0) or 0.0)
+                    for e in rec.get("shards", {}).values()
+                ) and len(rec.get("shards", {})) == n
+                if covered:
+                    now = time.monotonic()
+                    with self._lock:
+                        for t0, event in pending:
+                            event["rebalance_s"] = round(now - t0, 3)
+                    pending = []
+
+    def report(self) -> list:
+        with self._lock:
+            return [dict(e) for e in self.events]
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        self.join(timeout=5)
+
+
 class ReplicatedBusTopology(LoadgenTopology):
     """The replicated persistent bus under load: N real
     ``vtpu-apiserver`` OS processes (WAL dirs, leader election, quorum
@@ -344,42 +433,22 @@ class ReplicatedBusTopology(LoadgenTopology):
                  period: float, debounce_ms: float, n_replicas: int = 3,
                  lease_ttl: float = 1.0, micro_cycles: bool = True,
                  startup_timeout: float = 120.0):
-        import socket as _socket
-        import subprocess
-
         from volcano_tpu.bus.remote import RemoteAPIServer
         from volcano_tpu.client import ADDED, KubeClient, MODIFIED, VolcanoClient
         from volcano_tpu.client.apiserver import ApiError
 
-        def free_port():
-            with _socket.socket() as s:
-                s.bind(("127.0.0.1", 0))
-                return s.getsockname()[1]
-
         self.n_replicas = n_replicas
         self.lease_ttl = lease_ttl
-        ports = [free_port() for _ in range(n_replicas)]
+        ports = [_free_port() for _ in range(n_replicas)]
         self.endpoints = [f"tcp://127.0.0.1:{p}" for p in ports]
         self.bus_address = ",".join(self.endpoints)
         self._data_root = tempfile.mkdtemp(prefix="loadgen-bus-")
         self.procs = []
         self._logs = []
-        for i, port in enumerate(ports):
-            log_path = os.path.join(tempfile.gettempdir(),
-                                    f"loadgen-apiserver{i}.log")
-            logf = open(log_path, "w")  # noqa: SIM115 — held for the proc
-            self._logs.append(logf)
-            self.procs.append(subprocess.Popen(
-                [sys.executable, "-m", "volcano_tpu.cmd.apiserver",
-                 "--listen-host", "127.0.0.1", "--port", str(port),
-                 "--listen-port", "0",
-                 "--data-dir", os.path.join(self._data_root, f"r{i}"),
-                 "--replicas", self.bus_address,
-                 "--replica-index", str(i),
-                 "--repl-lease-ttl", str(lease_ttl)],
-                stdout=logf, stderr=subprocess.STDOUT,
-                env=dict(os.environ),
-            ))
+        #: membership-drill forensics ({"op", "url", "ok", "error"})
+        self.membership_events = []
+        for i in range(n_replicas):
+            self._spawn_apiserver(i, self.bus_address)
 
         # the audit/submission client dials the endpoint list REVERSED:
         # the staggered election makes replica 0 the bootstrap leader
@@ -442,6 +511,134 @@ class ReplicatedBusTopology(LoadgenTopology):
         )
         self._reaper.start()
         self._start_scheduler(conf_path, period, debounce_ms, micro_cycles)
+
+    def _spawn_apiserver(self, index: int, replicas: str):
+        """Start one real ``vtpu-apiserver`` process.  ``replicas`` is
+        the endpoint list IT is told (a joiner gets the new full list;
+        the original members keep theirs — the replicated membership
+        config reconciles them after the add commits)."""
+        import subprocess
+
+        log_path = os.path.join(tempfile.gettempdir(),
+                                f"loadgen-apiserver{index}.log")
+        logf = open(log_path, "w")  # noqa: SIM115 — held for the proc
+        self._logs.append(logf)
+        port = int(self.endpoints[index].rsplit(":", 1)[1])
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "volcano_tpu.cmd.apiserver",
+             "--listen-host", "127.0.0.1", "--port", str(port),
+             "--listen-port", "0",
+             "--data-dir", os.path.join(self._data_root, f"r{index}"),
+             "--replicas", replicas,
+             "--replica-index", str(index),
+             "--repl-lease-ttl", str(self.lease_ttl)],
+            stdout=logf, stderr=subprocess.STDOUT,
+            env=dict(os.environ),
+        )
+        if index < len(self.procs):
+            self.procs[index] = proc
+        else:
+            self.procs.append(proc)
+        return proc
+
+    # ---- the membership add-then-remove drill ----
+
+    def add_replica_member(self) -> dict:
+        """Grow the group by ONE mid-stream: spawn a fresh apiserver
+        told the whole NEW endpoint list (itself last), let it attach
+        as a learner, then ask the group (through whichever replica we
+        are connected to — a follower proxies) to admit it.  Retried
+        across the catch-up window; the event record lands in
+        ``membership_events`` for the report."""
+        from volcano_tpu.client.apiserver import ApiError
+
+        index = len(self.endpoints)
+        url = f"tcp://127.0.0.1:{_free_port()}"
+        self.endpoints.append(url)
+        self._spawn_apiserver(index, ",".join(self.endpoints))
+        event = {"op": "add", "url": url, "ok": False, "error": ""}
+        deadline = time.monotonic() + max(self.lease_ttl * 30, 60.0)
+        while time.monotonic() < deadline:
+            try:
+                res = self.api.bus_add_replica(url)
+                event.update(ok=True, epoch=res.get("epoch"),
+                             endpoints=res.get("endpoints"))
+                break
+            except ApiError as e:
+                event["error"] = str(e)
+                if "already a member" in str(e):
+                    event["ok"] = True  # an earlier ambiguous try won
+                    break
+                time.sleep(0.5)
+        self.membership_events.append(event)
+        return event
+
+    def remove_replica_member(self) -> dict:
+        """Shrink the group by ONE mid-stream: retire the first
+        ORIGINAL follower (never the leader — the op refuses that) and
+        terminate its process once the config commits."""
+        from volcano_tpu.client.apiserver import ApiError
+
+        event = {"op": "remove", "url": "", "ok": False, "error": ""}
+        deadline = time.monotonic() + max(self.lease_ttl * 30, 60.0)
+        while time.monotonic() < deadline:
+            lidx = self.leader_index()
+            victims = [
+                i for i in range(self.n_replicas)
+                if i != lidx and self.procs[i].poll() is None
+            ]
+            if lidx is None or not victims:
+                time.sleep(0.5)
+                continue
+            url = self.endpoints[victims[0]]
+            event["url"] = url
+            try:
+                res = self.api.bus_remove_replica(url)
+                event.update(ok=True, epoch=res.get("epoch"),
+                             endpoints=res.get("endpoints"))
+                # the retired replica stood down; take its process out
+                # so the end-state probe proves the group is healthy
+                # WITHOUT it
+                self.procs[victims[0]].terminate()
+                break
+            except ApiError as e:
+                event["error"] = str(e)
+                if "is not a member" in str(e):
+                    # an earlier ambiguous attempt committed (the
+                    # answer was lost to a failover/proxy teardown) —
+                    # the config no longer lists the victim, which is
+                    # the outcome the drill wanted
+                    event["ok"] = True
+                    self.procs[victims[0]].terminate()
+                    break
+                time.sleep(0.5)
+        self.membership_events.append(event)
+        return event
+
+    def membership_report(self) -> dict:
+        """End-state membership truth: every live replica's epoch and
+        endpoint list (the `exactly one surviving config` gate reads
+        this), plus the drill's event log."""
+        from volcano_tpu.bus.replication import probe_status
+
+        epochs = {}
+        configs = set()
+        for i, url in enumerate(self.endpoints):
+            if i < len(self.procs) and self.procs[i].poll() is not None:
+                continue
+            st = probe_status(url)
+            if st is None or st.get("role") == "removed":
+                continue
+            epochs[url] = st.get("membership_epoch")
+            members = st.get("membership")
+            if members is not None:
+                configs.add(tuple(members))
+        return {
+            "events": list(self.membership_events),
+            "epochs": epochs,
+            "distinct_configs": len(configs),
+            "config": sorted(configs.pop()) if len(configs) == 1 else None,
+        }
 
     def submit_job(self, name: str, tasks: int, cpu: str):
         """Bounded, IDEMPOTENT retry across the failover window: an
@@ -828,6 +1025,30 @@ def run_loadgen(args) -> dict:
 
     def fresh_topo():
         if args.shards > 0:
+            ramp_flags = []
+            n_members = 0
+            if args.ramp:
+                # the scale-up-under-load drill: every member runs the
+                # autoscale controller with a CI-tight policy (short
+                # sustain/cooldown, queue-depth trigger) and the member
+                # pool is pre-provisioned to the ceiling so the
+                # rebalance gate measures the LEASE PLANE, not Python
+                # process startup.  Scale-down is disabled for the
+                # drill (down-pending 0 can never be breached): the
+                # drill gates the up transition; the drain must not
+                # race a shrink re-key.
+                n_members = args.ramp_max_shards
+                ramp_flags = [
+                    "--shard-autoscale", "on",
+                    "--autoscale-min", str(args.shards),
+                    "--autoscale-max", str(args.ramp_max_shards),
+                    "--autoscale-up-pending", str(args.ramp_up_pending),
+                    "--autoscale-up-p99-ms", "1500",
+                    "--autoscale-down-pending", "0",
+                    "--autoscale-sustain", "2",
+                    "--autoscale-cooldown-s", "3.0",
+                    "--autoscale-period-s", "0.5",
+                ]
             topo = FederatedTopology(
                 n_nodes=args.nodes, node_cpu=args.node_cpu,
                 conf_path=conf_path, period=args.period,
@@ -835,6 +1056,8 @@ def run_loadgen(args) -> dict:
                 n_shards=args.shards,
                 lease_duration=args.shard_lease_duration,
                 micro_cycles=not args.no_micro_cycles,
+                n_members=n_members,
+                extra_flags=ramp_flags,
             )
         elif args.apiserver_replicas > 0:
             topo = ReplicatedBusTopology(
@@ -857,7 +1080,15 @@ def run_loadgen(args) -> dict:
 
     def one_run(rate: float, label: str) -> dict:
         topo = fresh_topo()
-        killer = None
+        killers = []
+        drill_done = threading.Event()
+        drill_done.set()  # only the membership drill clears it
+        scale_watcher = None
+        if args.ramp:
+            scale_watcher = _ScaleWatcher(
+                topo.api, args.shard_lease_duration
+            )
+            scale_watcher.start()
         try:
             # warmup: prime the jit cache + watch streams off the clock,
             # so the first measured pod doesn't pay a kernel compile.
@@ -885,6 +1116,29 @@ def run_loadgen(args) -> dict:
                 )
                 killer.daemon = True
                 killer.start()
+                killers.append(killer)
+            if args.apiserver_replicas > 0 and args.membership_drill:
+                # the elastic-membership drill: grow the replication
+                # group by one mid-stream (spawn + learner catch-up +
+                # add-replica), then retire an original follower — all
+                # while the open-loop arrivals keep landing.  Gates:
+                # both changes commit, exactly ONE surviving config,
+                # zero lost acked binds, zero re-binds.
+                drill_done.clear()
+
+                def _membership_drill():
+                    try:
+                        topo.add_replica_member()
+                        time.sleep(1.0)
+                        topo.remove_replica_member()
+                    finally:
+                        drill_done.set()
+
+                killer = threading.Timer(args.membership_after,
+                                         _membership_drill)
+                killer.daemon = True
+                killer.start()
+                killers.append(killer)
             if args.apiserver_replicas > 0 and args.kill_apiserver_after > 0:
                 # the bus-HA drill: SIGKILL the apiserver LEADER
                 # mid-stream; a follower must promote within one lease
@@ -897,6 +1151,7 @@ def run_loadgen(args) -> dict:
                 )
                 killer.daemon = True
                 killer.start()
+                killers.append(killer)
             if args.stage_breakdown and hasattr(topo, "scheduler"):
                 # flight recorder on the in-process scheduler: spans
                 # batch to the topology's store; attribution runs AFTER
@@ -922,8 +1177,34 @@ def run_loadgen(args) -> dict:
                     report["bus_ha"]["killed_leader"] = killed.get(
                         "id", "<kill timer never fired>"
                     )
+                if args.membership_drill:
+                    # the drill thread may still be mid-change when the
+                    # drain finishes — the report must show END state
+                    drill_done.wait(120.0)
+                    report["bus_ha"]["membership"] = (
+                        topo.membership_report()
+                    )
             if args.shards > 0:
                 report["federation"] = topo.shard_report()
+                if scale_watcher is not None:
+                    # give a mid-flight rebalance a bounded window to
+                    # complete before stamping the report — the gate
+                    # itself is judged in main()
+                    gate_s = (args.ramp_rebalance_ttls
+                              * args.shard_lease_duration)
+                    deadline = time.monotonic() + gate_s
+                    while time.monotonic() < deadline:
+                        events = scale_watcher.report()
+                        if events and all(
+                            e["rebalance_s"] is not None for e in events
+                        ):
+                            break
+                        time.sleep(0.2)
+                    report["elastic"] = {
+                        "events": scale_watcher.report(),
+                        "lease_ttl_s": args.shard_lease_duration,
+                        "gate_ttls": args.ramp_rebalance_ttls,
+                    }
                 if args.kill_shard_after > 0:
                     report["killed_member"] = "shard0"
                 from volcano_tpu.federation import verify_federation
@@ -934,8 +1215,10 @@ def run_loadgen(args) -> dict:
                     report["policy_violations"] = policy["violations"][:20]
             return report
         finally:
-            if killer is not None:
+            for killer in killers:
                 killer.cancel()
+            if scale_watcher is not None:
+                scale_watcher.stop()
             if args.stage_breakdown:
                 from volcano_tpu import obs as _obs
 
@@ -1049,6 +1332,38 @@ def main(argv=None) -> int:
     p.add_argument("--gang-slo-ms", type=float, default=0.0,
                    help="gate: fail when gang full-assembly p99 "
                    "exceeds this (0 = report only)")
+    p.add_argument("--ramp", action="store_true",
+                   help="elastic scale-up-under-load drill (needs "
+                   "--shards >= 1): members run the SLO-driven shard "
+                   "autoscaler with a CI-tight policy and the member "
+                   "pool is pre-provisioned to --ramp-max-shards; the "
+                   "offered stream oversubscribes the fleet so a "
+                   "sustained pending backlog forms, the controller "
+                   "grows the shard count, and the exit gates require "
+                   "zero lost acked binds plus every committed scale "
+                   "event rebalanced within --ramp-rebalance-ttls "
+                   "lease TTLs")
+    p.add_argument("--ramp-max-shards", type=int, default=2,
+                   help="autoscaler ceiling (and pre-provisioned "
+                   "member-pool size) for the ramp drill")
+    p.add_argument("--ramp-up-pending", type=int, default=8,
+                   help="per-shard pending-task bar the drill's "
+                   "scale-up trigger uses")
+    p.add_argument("--ramp-rebalance-ttls", type=float, default=8.0,
+                   help="gate: every committed scale event must have "
+                   "every slice of the new partition re-held within "
+                   "this many lease TTLs")
+    p.add_argument("--membership-drill", action="store_true",
+                   help="dynamic-membership drill (needs "
+                   "--apiserver-replicas >= 2): grow the replication "
+                   "group by one mid-stream (spawn + learner catch-up "
+                   "+ add-replica), then retire an original follower "
+                   "— exit gates: both changes commit, exactly ONE "
+                   "surviving config, zero lost acked binds, zero "
+                   "re-binds")
+    p.add_argument("--membership-after", type=float, default=1.0,
+                   help="seconds into the measured stream the "
+                   "membership drill starts")
     p.add_argument("--kill-shard-after", type=float, default=0.0,
                    help="SIGKILL shard member 0 this many seconds into "
                    "the measured stream (federation chaos: survivors "
@@ -1064,12 +1379,31 @@ def main(argv=None) -> int:
                    help="CI smoke preset: small fleet, short stream")
     args = p.parse_args(argv)
 
+    if args.ramp and args.shards < 1:
+        args.shards = 1  # the drill starts from a 1-shard federation
+    if args.membership_drill and args.apiserver_replicas < 2:
+        p.error("--membership-drill needs --apiserver-replicas >= 2")
+
     if args.quick:
         args.rate = 25.0
         args.duration = 4.0
         args.nodes = 16
         args.node_cpu = 64
         args.drain_timeout = 60.0
+        if args.ramp:
+            # the scale-up drill needs a SUSTAINED backlog: offered
+            # residency (rate × complete_after_s × slots-per-pod) must
+            # exceed the fleet's slot capacity, so pending depth holds
+            # above the trigger bar until the stream ends.  8 nodes ×
+            # 8 cpu at 1-cpu pods = 64 slots; 90 pods/s × 1s residency
+            # ≈ 90 resident demand → a steady ~25-task queue.
+            args.nodes = 8
+            args.node_cpu = 8
+            args.cpu = "1"
+            args.rate = 75.0
+            args.duration = 5.0
+            args.complete_after_s = 1.0
+            args.drain_timeout = 180.0
         if args.gang_mix > 0:
             # gang arrivals are node-sized: 25 jobs/s of half-node
             # tasks would oversubscribe the 16-node quick fleet many
@@ -1101,6 +1435,36 @@ def main(argv=None) -> int:
         if args.gang_slo_ms > 0 and gm["assembly_p99_ms"] > args.gang_slo_ms:
             print(f"LOADGEN FAIL: gang assembly p99 "
                   f"{gm['assembly_p99_ms']}ms > SLO {args.gang_slo_ms}ms",
+                  file=sys.stderr)
+            return 1
+    if args.ramp:
+        el = r.get("elastic", {})
+        ups = [e for e in el.get("events", ())
+               if e.get("direction") == "up"]
+        if not ups:
+            print("LOADGEN FAIL: the ramp drill committed no scale-up "
+                  f"(events: {el.get('events')})", file=sys.stderr)
+            return 1
+        gate_s = args.ramp_rebalance_ttls * args.shard_lease_duration
+        for e in el.get("events", ()):
+            if e.get("rebalance_s") is None or e["rebalance_s"] > gate_s:
+                print("LOADGEN FAIL: scale event "
+                      f"{e['from']}->{e['target']} rebalanced in "
+                      f"{e.get('rebalance_s')}s > gate {gate_s}s "
+                      f"({args.ramp_rebalance_ttls} lease TTLs)",
+                      file=sys.stderr)
+                return 1
+    if args.membership_drill:
+        mem = r.get("bus_ha", {}).get("membership", {})
+        bad = [e for e in mem.get("events", ()) if not e.get("ok")]
+        if bad or len(mem.get("events", ())) != 2:
+            print(f"LOADGEN FAIL: membership drill events: "
+                  f"{mem.get('events')}", file=sys.stderr)
+            return 1
+        if mem.get("distinct_configs") != 1:
+            print("LOADGEN FAIL: live replicas disagree on the "
+                  f"membership config ({mem.get('distinct_configs')} "
+                  f"distinct; epochs {mem.get('epochs')})",
                   file=sys.stderr)
             return 1
     if args.apiserver_replicas > 0:

@@ -1,0 +1,588 @@
+"""SLO-driven shard autoscaling (ISSUE 14).
+
+The controller half of "elastic operations": the member holding shard
+0's lease windows the fleet's submit→bind p99 and pending depth (both
+piggybacked on the lease-map heartbeats) and CASes one-step shard-count
+changes into the map with hysteresis, sustain, and cooldown; every
+member's lease manager then ADOPTS the map's count (elastic mode)
+through the same absorb/shed machinery every rebalance uses.
+
+Pinned here: the pure decision function's hysteresis band, the
+windowed-latency discipline (an old spike can never hold the fleet
+scaled up), sustain/cooldown damping, the CAS commit's exact map
+mutation (grown slices start unheld, shrunk slices disappear), elastic
+adoption end-to-end over a real in-process lease plane, the metrics
+export, and the `vtctl shards` autoscale line.  The full OS-process
+drill is `bench/loadgen.py --ramp` (the `elastic-slo` CI artifact).
+"""
+
+import io
+import json
+import threading
+import time
+
+import pytest
+
+from volcano_tpu.apis import core
+from volcano_tpu.client.apiserver import APIServer
+from volcano_tpu.federation.autoscale import (
+    AutoscalePolicy,
+    ShardAutoscaler,
+    decide,
+    delta_histogram,
+    latency_snapshot,
+)
+from volcano_tpu.federation.leases import (
+    NAMESPACE,
+    SHARD_MAP_KEY,
+    SHARD_MAP_NAME,
+    ShardLeaseManager,
+    read_shard_map,
+)
+from volcano_tpu.metrics import metrics
+from volcano_tpu.metrics.scrape import histogram_quantile, merge_histograms
+
+
+def _wait(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _map_cm(rec):
+    return core.ConfigMap(
+        metadata=core.ObjectMeta(name=SHARD_MAP_NAME,
+                                 namespace=NAMESPACE),
+        data={SHARD_MAP_KEY: json.dumps(rec)},
+    )
+
+
+def _rec(n_shards=1, members=("m0",), stats=None, autoscale=None):
+    shards = {
+        str(i): {"holder": "m0", "renewTime": time.time(),
+                 "leaseDurationSeconds": 2.0}
+        for i in range(n_shards)
+    }
+    rec = {
+        "nShards": n_shards,
+        "members": {m: {"heartbeat": time.time(),
+                        "leaseDurationSeconds": 2.0} for m in members},
+        "shards": shards,
+        "stats": stats or {},
+    }
+    if autoscale is not None:
+        rec["autoscale"] = autoscale
+    return rec
+
+
+def _latency(count, le_ms, total_ms):
+    """A cumulative snapshot whose observations all sit in the
+    (le_ms/10, le_ms] bucket — p99 lands inside that bucket."""
+    return {
+        "buckets": [(str(le_ms / 10), 0.0), (str(le_ms), float(count)),
+                    ("+Inf", float(count))],
+        "sum": float(total_ms),
+        "count": float(count),
+    }
+
+
+class _State:
+    """state stub: owns_shard(0) answers the controller-placement rule."""
+
+    def __init__(self, owns=True):
+        self.owns = owns
+
+    def owns_shard(self, shard):
+        return self.owns and shard == 0
+
+
+POLICY = AutoscalePolicy(
+    min_shards=1, max_shards=4, up_p99_ms=500.0, up_pending=16,
+    down_p99_ms=50.0, down_pending=4, sustain=2, cooldown_s=0.0,
+    eval_period_s=0.05,
+)
+
+
+class TestDecide:
+    def test_up_on_p99_breach(self):
+        assert decide(POLICY, 1, 900.0, 0, True) == "up"
+
+    def test_up_on_pending_breach_without_latency(self):
+        # queue depth catches the saturated-but-not-yet-slow ramp
+        assert decide(POLICY, 1, 0.0, 17, False) == "up"
+
+    def test_pending_bar_is_per_shard(self):
+        assert decide(POLICY, 2, 0.0, 17, False) is None
+        assert decide(POLICY, 2, 0.0, 40, False) == "up"
+
+    def test_hysteresis_band_holds(self):
+        # between the bars: no decision in either direction
+        assert decide(POLICY, 2, 200.0, 8, True) is None
+
+    def test_down_needs_both_signals_low(self):
+        assert decide(POLICY, 2, 30.0, 2, True) == "down"
+        # pending above the DOWN bar (but under the up bar): hold
+        assert decide(POLICY, 2, 30.0, 10, True) is None
+        assert decide(POLICY, 2, 200.0, 2, True) is None   # p99 not low
+
+    def test_idle_fleet_scales_down(self):
+        # no latency window at all + nothing pending IS the idle case
+        assert decide(POLICY, 2, 0.0, 0, False) == "down"
+
+    def test_min_max_clamps(self):
+        assert decide(POLICY, POLICY.max_shards, 900.0, 999, True) is None
+        assert decide(POLICY, POLICY.min_shards, 0.0, 0, False) is None
+
+    def test_policy_validates_bounds(self):
+        with pytest.raises(ValueError):
+            AutoscalePolicy(min_shards=0)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(min_shards=3, max_shards=2)
+
+
+class TestWindowedLatency:
+    def test_delta_is_pointwise_difference(self):
+        prev = _latency(10, 1000, 9000)
+        cur = {
+            "buckets": [("100", 5.0), ("1000", 15.0), ("+Inf", 15.0)],
+            "sum": 9400.0,
+            "count": 15.0,
+        }
+        win = delta_histogram(prev, cur)
+        assert win["count"] == 5.0
+        assert win["sum"] == 400.0
+        assert dict(win["buckets"])["100"] == 5.0
+        # the delta'd window is scrape-shaped: the shared quantile
+        # helpers consume it unchanged
+        assert histogram_quantile(merge_histograms([win]), 0.99) <= 100.0
+
+    def test_first_sight_is_full_window(self):
+        cur = _latency(10, 1000, 9000)
+        assert delta_histogram(None, cur) == cur
+
+    def test_member_restart_resets_window(self):
+        prev = _latency(100, 1000, 90000)
+        cur = _latency(3, 1000, 2700)  # counter went BACKWARD: restart
+        assert delta_histogram(prev, cur) == cur
+
+    def test_latency_snapshot_matches_scrape_shape(self):
+        metrics.observe_submit_to_bind(12.5)
+        snap = latency_snapshot()
+        assert snap is not None and snap["count"] >= 1
+        assert snap["buckets"][-1][0] == "+Inf"
+        assert histogram_quantile(snap, 0.5) > 0
+
+
+class TestOwnedPending:
+    def test_per_member_reports_partition_the_backlog(self):
+        """At n_shards == 1 every member's raw pending view IS the
+        whole fleet backlog (the filter forwards everything) — the
+        published signal must be scoped to OWNED home shards so a
+        pre-provisioned standby reports 0 and summing per-member
+        reports never multiplies the backlog."""
+        from volcano_tpu.federation.autoscale import owned_pending
+        from volcano_tpu.federation.sharding import home_shard
+
+        view = [
+            {"job_id": f"ns/job{i}", "tasks": [object()] * 2}
+            for i in range(8)
+        ]
+        # one shard: the holder reports everything, a standby nothing
+        assert owned_pending(view, {0}, 1) == 16
+        assert owned_pending(view, set(), 1) == 0
+        # two shards: the two members' reports partition the total
+        a = owned_pending(view, {0}, 2)
+        b = owned_pending(view, {1}, 2)
+        assert a + b == 16
+        assert a == sum(
+            2 for i in range(8) if home_shard("ns", f"job{i}", 2) == 0
+        )
+
+
+class TestAutoscalerTick:
+    def _scaler(self, api, policy=POLICY, owns=True):
+        return ShardAutoscaler(api, _State(owns), "m0", policy=policy)
+
+    def test_sustained_pending_breach_commits_one_step_up(self):
+        api = APIServer()
+        api.create(_map_cm(_rec(
+            stats={"m0": {"pendingTasks": 40}},
+        )))
+        sc = self._scaler(api)
+        sc._tick()  # streak 1 of 2: no commit yet (sustain damping)
+        assert read_shard_map(api)["nShards"] == 1
+        sc._tick()  # streak 2: commit
+        rec = read_shard_map(api)
+        assert rec["nShards"] == 2
+        # the grown slice starts UNHELD at renewTime 0 — infinitely
+        # orphaned, so the expiry backstop deals it out within a TTL
+        assert rec["shards"]["1"] == {
+            "holder": "", "renewTime": 0.0, "leaseDurationSeconds": 0.0,
+        }
+        blob = rec["autoscale"]
+        assert blob["direction"] == "up" and blob["target"] == 2
+        assert blob["decisions"] == 1
+        assert sc.counters() == {"up": 1}
+        assert ('volcano_shard_autoscale_decisions_total'
+                '{direction="up"}') in metrics.registry.render()
+
+    def test_p99_breach_scales_up_and_window_resets(self):
+        api = APIServer()
+        api.create(_map_cm(_rec(
+            stats={"m0": {"pendingTasks": 0,
+                          "latency": _latency(50, 1000, 45000)}},
+        )))
+        sc = self._scaler(api)
+        sc._tick()  # first sight: a full 50-obs slow window, streak 1
+        # load continues — the member's CUMULATIVE histogram advances,
+        # so the next delta is another 50 slow observations
+        cm = api.get("ConfigMap", NAMESPACE, SHARD_MAP_NAME)
+        rec = json.loads(cm.data[SHARD_MAP_KEY])
+        rec["stats"]["m0"]["latency"] = _latency(100, 1000, 90000)
+        cm.data = {SHARD_MAP_KEY: json.dumps(rec)}
+        api.compare_and_update(cm, cm.metadata.resource_version)
+        sc._tick()  # streak 2: commit up
+        assert read_shard_map(api)["nShards"] == 2
+        # the stream stops: the SAME cumulative snapshot deltas to an
+        # EMPTY window — the stale spike cannot hold the fleet up, and
+        # with pending at 0 the idle fleet walks back DOWN
+        sc._tick()
+        sc._tick()
+        assert read_shard_map(api)["nShards"] == 1
+        assert sc.counters() == {"up": 1, "down": 1}
+
+    def test_down_removes_the_shrunk_slice(self):
+        api = APIServer()
+        api.create(_map_cm(_rec(
+            n_shards=2, stats={"m0": {"pendingTasks": 0}},
+        )))
+        sc = self._scaler(api)
+        sc._tick()
+        sc._tick()
+        rec = read_shard_map(api)
+        assert rec["nShards"] == 1
+        assert "1" not in rec["shards"]
+        assert rec["autoscale"]["direction"] == "down"
+
+    def test_cooldown_blocks_consecutive_changes(self):
+        api = APIServer()
+        api.create(_map_cm(_rec(stats={"m0": {"pendingTasks": 40}})))
+        policy = AutoscalePolicy(
+            min_shards=1, max_shards=4, up_pending=16, sustain=1,
+            cooldown_s=60.0,
+        )
+        sc = self._scaler(api, policy=policy)
+        sc._tick()
+        assert read_shard_map(api)["nShards"] == 2  # first change free
+        sc._tick()
+        sc._tick()
+        assert read_shard_map(api)["nShards"] == 2  # cooldown holds
+        # the stamp lives IN THE MAP: a migrated controller (fresh
+        # object, same map) keeps the cooldown
+        sc2 = self._scaler(api, policy=policy)
+        sc2._tick()
+        assert read_shard_map(api)["nShards"] == 2
+
+    def test_non_holder_is_inert_and_drops_streak(self):
+        api = APIServer()
+        api.create(_map_cm(_rec(stats={"m0": {"pendingTasks": 40}})))
+        sc = self._scaler(api, owns=False)
+        sc._tick()
+        sc._tick()
+        assert read_shard_map(api)["nShards"] == 1
+        # a controller that migrates HERE must earn a fresh sustain
+        # window, not inherit a half-counted one
+        assert sc._streak == 0 and sc._streak_dir is None
+
+    def test_dead_member_stats_are_not_load(self):
+        api = APIServer()
+        api.create(_map_cm(_rec(
+            members=("m0",),
+            stats={"m0": {"pendingTasks": 0},
+                   "ghost": {"pendingTasks": 999}},
+        )))
+        sc = self._scaler(api)
+        sig = sc._signals(read_shard_map(api))
+        assert sig["pending"] == 0
+
+    def test_commit_traces_a_span_when_recorder_on(self):
+        from volcano_tpu import obs
+
+        api = APIServer()
+        api.create(_map_cm(_rec(stats={"m0": {"pendingTasks": 40}})))
+        policy = AutoscalePolicy(min_shards=1, max_shards=4,
+                                 up_pending=16, sustain=1,
+                                 cooldown_s=0.0)
+        sc = self._scaler(api, policy=policy)
+        obs.enable(api, identity="autoscale-test")
+        try:
+            sc._tick()
+        finally:
+            obs.disable()
+        assert read_shard_map(api)["nShards"] == 2
+
+    def test_lost_cas_is_one_retry_tick(self):
+        api = APIServer()
+        api.create(_map_cm(_rec(stats={"m0": {"pendingTasks": 40}})))
+        policy = AutoscalePolicy(min_shards=1, max_shards=4,
+                                 up_pending=16, sustain=1, cooldown_s=0.0)
+        sc = self._scaler(api, policy=policy)
+        real_cau = api.compare_and_update
+        calls = []
+
+        def racing_cau(obj, rv):
+            if not calls:
+                calls.append(1)
+                from volcano_tpu.client.apiserver import ConflictError
+
+                raise ConflictError("lease renewal won the rv")
+            return real_cau(obj, rv)
+
+        api.compare_and_update = racing_cau
+        sc._tick()
+        assert read_shard_map(api)["nShards"] == 1  # lost the race
+        sc._tick()
+        assert read_shard_map(api)["nShards"] == 2  # next tick lands
+
+
+class TestElasticAdoption:
+    def test_members_adopt_a_grown_map_and_hold_every_slice(self):
+        """End-to-end over a real in-process lease plane: two elastic
+        members form a 1-shard federation; a committed autoscale
+        decision grows the map to 2; both members re-key and the grown
+        slice is absorbed — every slice held, by distinct members."""
+        api = APIServer()
+        resizes = []
+        mgrs = [
+            ShardLeaseManager(
+                api, f"m{i}", 1, lease_duration=0.8, retry_period=0.1,
+                elastic=True,
+                on_resize=lambda n, i=i: resizes.append((i, n)),
+            )
+            for i in range(2)
+        ]
+        try:
+            for m in mgrs:
+                m.start()
+            assert _wait(lambda: (read_shard_map(api) or {}).get(
+                "shards", {}).get("0", {}).get("holder"), timeout=10.0)
+
+            # a committed scale-up: nShards 2, grown slice unheld (the
+            # exact mutation TestAutoscalerTick pins on the controller)
+            def grow():
+                cm = api.get("ConfigMap", NAMESPACE, SHARD_MAP_NAME)
+                rec = json.loads(cm.data[SHARD_MAP_KEY])
+                rec["nShards"] = 2
+                rec["shards"]["1"] = {
+                    "holder": "", "renewTime": 0.0,
+                    "leaseDurationSeconds": 0.0,
+                }
+                rec["autoscale"] = {"enabled": True, "target": 2,
+                                    "lastChange": time.time(),
+                                    "direction": "up", "reason": "test",
+                                    "decisions": 1}
+                cm.data = {SHARD_MAP_KEY: json.dumps(rec, sort_keys=True)}
+                from volcano_tpu.client.apiserver import ConflictError
+
+                try:
+                    api.compare_and_update(
+                        cm, cm.metadata.resource_version
+                    )
+                    return True
+                except ConflictError:
+                    return False
+
+            assert _wait(grow, timeout=5.0)
+
+            def both_held():
+                rec = read_shard_map(api) or {}
+                shards = rec.get("shards", {})
+                if rec.get("nShards") != 2 or len(shards) != 2:
+                    return False
+                holders = {e.get("holder") for e in shards.values()}
+                return (
+                    all(h for h in holders)
+                    and holders == {"m0", "m1"}
+                )
+
+            assert _wait(both_held, timeout=15.0), read_shard_map(api)
+            assert any(n == 2 for _, n in resizes)
+        finally:
+            for m in mgrs:
+                m.stop(release=True)
+
+
+class TestElasticRekeyUnderChurn:
+    def test_no_job_lost_across_a_scale_up_rekey(self, tmp_path):
+        """The in-process half of the ``loadgen --ramp`` drill: two
+        FEDERATED members (real caches, filters, leases, spillover)
+        over a real TCP bus; the shard map grows 1 -> 2 (the exact
+        mutation the autoscaler commits) WHILE jobs keep arriving.
+        Both members release-and-re-key; every job submitted before,
+        during, and after the re-key still binds — the relist-on-
+        acquire discipline covers the windows where a member owns
+        nothing."""
+        from volcano_tpu.bus.remote import RemoteAPIServer
+        from volcano_tpu.bus.server import BusServer
+        from volcano_tpu.client import KubeClient, VolcanoClient
+        from volcano_tpu.federation import FederatedScheduler
+        from tests.builders import (
+            build_node,
+            build_pod,
+            build_pod_group,
+            build_queue,
+        )
+
+        conf = tmp_path / "conf.yaml"
+        conf.write_text(
+            'actions: "enqueue, allocate"\n'
+            "tiers:\n"
+            "- plugins:\n"
+            "  - name: priority\n"
+            "  - name: gang\n"
+            "- plugins:\n"
+            "  - name: drf\n"
+            "  - name: predicates\n"
+            "  - name: proportion\n"
+            "  - name: nodeorder\n"
+            "  - name: binpack\n"
+        )
+        api = APIServer()
+        bus = BusServer(api).start()
+        kube = KubeClient(api)
+        vc = VolcanoClient(api)
+        vc.create_queue(build_queue("default"))
+        for k in range(8):
+            kube.create_node(build_node(f"n{k:03d}",
+                                        {"cpu": "4", "memory": "64Gi"}))
+        # autoscale present (=> elastic leases) but the controller is
+        # inert: the test drives the map transition deterministically
+        inert = AutoscalePolicy(up_pending=10**6, up_p99_ms=10**9,
+                                down_pending=0, sustain=10**6)
+        remotes, feds = [], []
+        submitted = [0]
+
+        def submit(name):
+            vc.create_pod_group(build_pod_group("ns", name, 1))
+            kube.create_pod(build_pod(
+                "ns", f"{name}-t0", "",
+                {"cpu": "1", "memory": "1Gi"}, group=name,
+            ))
+            submitted[0] += 1
+
+        try:
+            for i in range(2):
+                r = RemoteAPIServer(f"tcp://127.0.0.1:{bus.port}",
+                                    timeout=5.0)
+                assert r.wait_ready(10)
+                remotes.append(r)
+                feds.append(FederatedScheduler(
+                    r, f"m{i}", 1, scheduler_conf_path=str(conf),
+                    lease_duration=2.0, lease_retry_period=0.2,
+                    spill_after=1, autoscale=inert,
+                ).start())
+
+            def cycle():
+                for f in feds:
+                    try:
+                        f.scheduler.run_once()
+                    except Exception:  # noqa: BLE001 — daemon loops log
+                        pass
+
+            assert _wait(lambda: (read_shard_map(api) or {}).get(
+                "shards", {}).get("0", {}).get("holder"), timeout=10.0)
+            for i in range(4):
+                submit(f"pre{i}")
+            assert _wait(
+                lambda: (cycle() or True) and all(
+                    p.spec.node_name for p in kube.list_pods("ns")
+                ),
+                timeout=30.0, interval=0.05,
+            )
+
+            # arrivals keep landing while the map grows
+            stop = threading.Event()
+
+            def churn():
+                i = 0
+                while not stop.is_set() and i < 16:
+                    submit(f"mid{i}")
+                    i += 1
+                    time.sleep(0.05)
+
+            t = threading.Thread(target=churn, daemon=True)
+            t.start()
+
+            def grow():
+                cm = api.get("ConfigMap", NAMESPACE, SHARD_MAP_NAME)
+                rec = json.loads(cm.data[SHARD_MAP_KEY])
+                rec["nShards"] = 2
+                rec["shards"]["1"] = {"holder": "", "renewTime": 0.0,
+                                      "leaseDurationSeconds": 0.0}
+                cm.data = {SHARD_MAP_KEY: json.dumps(rec,
+                                                     sort_keys=True)}
+                from volcano_tpu.client.apiserver import ApiError
+
+                try:
+                    api.compare_and_update(
+                        cm, cm.metadata.resource_version
+                    )
+                    return True
+                except ApiError:
+                    return False
+
+            assert _wait(grow, timeout=5.0)
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline:
+                cycle()
+                time.sleep(0.02)
+            stop.set()
+            t.join(timeout=5)
+            submit("post0")
+
+            def all_placed():
+                cycle()
+                pods = kube.list_pods("ns")
+                return len(pods) == submitted[0] and all(
+                    p.spec.node_name for p in pods
+                )
+
+            assert _wait(all_placed, timeout=60.0, interval=0.05), (
+                [p.metadata.name for p in kube.list_pods("ns")
+                 if not p.spec.node_name],
+                read_shard_map(api),
+            )
+            # both members ended re-keyed: the map's two slices held
+            rec = read_shard_map(api)
+            assert rec["nShards"] == 2
+        finally:
+            for f in feds:
+                try:
+                    f.stop()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+            for r in remotes:
+                r.close()
+            bus.stop()
+
+
+class TestVtctlAutoscaleLine:
+    def test_shards_renders_last_decision(self):
+        from volcano_tpu.cli.vtctl import main as vtctl_main
+
+        api = APIServer()
+        api.create(_map_cm(_rec(
+            n_shards=2,
+            autoscale={"enabled": True, "target": 2,
+                       "lastChange": 1000.0, "direction": "up",
+                       "reason": "p99=900ms pending=40 members=2",
+                       "decisions": 3},
+        )))
+        out = io.StringIO()
+        assert vtctl_main(["shards"], api=api, out=out) == 0
+        assert ("Autoscale:          target 2 (up: "
+                "p99=900ms pending=40 members=2; decisions 3)"
+                in out.getvalue())

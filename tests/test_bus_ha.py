@@ -667,6 +667,800 @@ class TestVtctlBusStatus:
                 r.stop()
 
 
+# ---- dynamic membership: WAL records, add/remove, pre-vote ----
+
+
+class TestMembershipWal:
+    def test_membership_epoch_recovered_alongside_term_seq_backlog(
+        self, tmp_path
+    ):
+        """A membership-config record consumes ONE synthetic slot in
+        the event-seq space (cursors move past it, the CRC chain covers
+        it) and the epoch recovers next to term/seq/backlog."""
+        d = str(tmp_path / "data")
+        api = PersistentAPIServer(d)
+        api.create(_cm("a"))
+        seq1 = api.log_membership(
+            {"epoch": 1, "endpoints": ["tcp://h:1", "tcp://h:2"]}
+        )
+        api.create(_cm("b"))
+        api.log_membership(
+            {"epoch": 2,
+             "endpoints": ["tcp://h:1", "tcp://h:2", "tcp://h:3"]}
+        )
+        api.set_term(4)
+        digest, seq, chain = store_digest(api), api.event_seq, api.chain
+        api.close()
+
+        rec = PersistentAPIServer(d)
+        assert store_digest(rec) == digest
+        assert rec.event_seq == seq
+        assert rec.chain == chain
+        assert rec.term == 4
+        cfg = rec.membership_config()
+        assert cfg == {"epoch": 2, "endpoints":
+                       ["tcp://h:1", "tcp://h:2", "tcp://h:3"]}
+        # the backlog (resume surface) skips the config records' seqs —
+        # no watcher ever saw an event there
+        backlog_seqs = [e["seq"] for e in rec.recent_events()]
+        assert backlog_seqs == [1, 3]
+        assert seq1 == 2
+        rec.close()
+
+    def test_membership_survives_snapshot_rotation(self, tmp_path):
+        d = str(tmp_path / "data")
+        api = PersistentAPIServer(d, snapshot_every=2)
+        api.log_membership({"epoch": 5, "endpoints": ["tcp://h:1"]})
+        for i in range(6):
+            api.create(_cm(f"c{i}"))
+        api.close()
+        assert os.path.exists(os.path.join(d, "snapshot.json"))
+        rec = PersistentAPIServer(d, snapshot_every=2)
+        assert rec.recovered["snapshot"]
+        assert rec.membership_config() == {
+            "epoch": 5, "endpoints": ["tcp://h:1"],
+        }
+        rec.close()
+
+    def test_truncation_at_every_byte_of_membership_record(self, tmp_path):
+        """The torn-tail property sweep extended to membership-config
+        records: a WAL whose FINAL record is a config change, truncated
+        at every byte offset of that record, recovers to exactly the
+        prefix (prior epoch, prior seq) — never an exception, never a
+        half-applied config."""
+        d = str(tmp_path / "data")
+        api = PersistentAPIServer(d)
+        api.create(_cm("a"))
+        api.log_membership({"epoch": 1, "endpoints": ["tcp://h:1"]})
+        api.create(_cm("b"))
+        api.log_membership(
+            {"epoch": 2, "endpoints": ["tcp://h:1", "tcp://h:2"]}
+        )
+        full_digest, full_seq = store_digest(api), api.event_seq
+        api.close()
+        wal = os.path.join(d, "wal.log")
+        payloads, total, _ = read_records(wal)
+        assert len(payloads) == 4
+        final_start = total - (8 + len(payloads[-1]))
+
+        for offset in range(final_start, total + 1):
+            case = str(tmp_path / f"case{offset}")
+            shutil.copytree(d, case)
+            with open(os.path.join(case, "wal.log"), "r+b") as f:
+                f.truncate(offset)
+            rec = PersistentAPIServer(case)
+            if offset == total:
+                assert store_digest(rec) == full_digest
+                assert rec.event_seq == full_seq
+                assert rec.membership_config()["epoch"] == 2
+            else:
+                # the torn config record applied NOTHING: the prior
+                # epoch survives whole
+                assert store_digest(rec) == full_digest  # objects same
+                assert rec.event_seq == full_seq - 1
+                assert rec.membership_config() == {
+                    "epoch": 1, "endpoints": ["tcp://h:1"],
+                }, f"offset {offset}"
+            rec.close()
+            shutil.rmtree(case)
+
+
+class TestDynamicMembership:
+    def test_add_replica_learner_catch_up_then_commit(self, tmp_path):
+        """Grow 3 -> 4 while running: the joiner attaches as a learner
+        (started with --replicas listing the whole new group, itself
+        last), bootstraps, and the membership record commits once its
+        lag has closed.  The new member then replicates writes."""
+        replicas, endpoints = _spawn_group(tmp_path, 3, lease_ttl=1.0)
+        cli = None
+        joiner = None
+        try:
+            assert _wait(
+                lambda: _roles(replicas).count("leader") == 1
+                and _roles(replicas).count("follower") == 2,
+                timeout=15.0,
+            ), _roles(replicas)
+            lidx = _roles(replicas).index("leader")
+            cli = RemoteAPIServer(endpoints[(lidx + 1) % 3])
+            assert cli.wait_ready(10)
+            cli.create(_cm("w0"))
+            # the first leader seeded epoch 1 (the static list) into
+            # the log — the base every later change is a delta against
+            assert _wait(
+                lambda: all(r.store.membership_config() is not None
+                            for r in replicas),
+                timeout=10.0,
+            )
+            assert replicas[lidx].store.membership_config()["epoch"] == 1
+
+            port = _free_port()
+            url = f"tcp://127.0.0.1:{port}"
+            joiner = _Replica(str(tmp_path / "r3"), endpoints + [url],
+                              3, port, lease_ttl=1.0).start()
+            # the operator surface end-to-end: vtctl parser → remote
+            # client → follower proxy → leader catch-up gate → commit
+            from volcano_tpu.cli.vtctl import main as vtctl_main
+
+            out = io.StringIO()
+            assert vtctl_main(
+                ["--bus", endpoints[(lidx + 1) % 3],
+                 "bus", "add-replica", url],
+                out=out,
+            ) == 0
+            assert "membership change committed" in out.getvalue()
+            assert "(epoch 2)" in out.getvalue()
+            assert url in out.getvalue()
+            # a retry of the SAME add is cleanly refused (idempotence
+            # surface the loadgen drill's ambiguous retries lean on)
+            with pytest.raises(ApiError, match="already a member"):
+                cli.bus_add_replica(url)
+            assert _wait(lambda: joiner.mgr.role == "follower",
+                         timeout=10.0), joiner.mgr.role
+            cli.create(_cm("w1"))
+            assert _wait(
+                lambda: joiner.store.get("ConfigMap", "ns", "w1")
+                is not None,
+                timeout=10.0,
+            )
+            st = probe_status(url)
+            assert st["membership_epoch"] == 2
+            assert sorted(endpoints + [url]) == st["membership"]
+        finally:
+            if cli is not None:
+                cli.close()
+            if joiner is not None:
+                joiner.stop()
+            for r in replicas:
+                try:
+                    r.stop()
+                except Exception:
+                    pass
+
+    def test_remove_replica_stands_down_and_group_commits(self, tmp_path):
+        replicas, endpoints = _spawn_group(tmp_path, 3, lease_ttl=1.0)
+        cli = None
+        try:
+            assert _wait(
+                lambda: _roles(replicas).count("leader") == 1
+                and _roles(replicas).count("follower") == 2,
+                timeout=15.0,
+            ), _roles(replicas)
+            lidx = _roles(replicas).index("leader")
+            cidx = (lidx + 1) % 3
+            victim = next(i for i in range(3)
+                          if i not in (lidx, cidx))
+            cli = RemoteAPIServer(endpoints[cidx])
+            assert cli.wait_ready(10)
+            cli.create(_cm("w0"))
+            res = cli.bus_remove_replica(endpoints[victim])
+            assert res["committed"] and res["epoch"] == 2
+            assert endpoints[victim] not in res["endpoints"]
+            # the retired replica stands down: alive, never pulls or
+            # elects (a restart re-admits it as a learner)
+            assert _wait(
+                lambda: replicas[victim].mgr.role == "removed",
+                timeout=15.0,
+            ), replicas[victim].mgr.role
+            # the shrunk group still commits (quorum of 2 = 2)
+            cli.create(_cm("w1"))
+            live = [r for i, r in enumerate(replicas) if i != victim]
+            assert _wait(
+                lambda: all(
+                    r.store.get("ConfigMap", "ns", "w1") is not None
+                    for r in live
+                ),
+                timeout=10.0,
+            )
+            cfgs = {
+                tuple(r.store.membership_config()["endpoints"])
+                for r in live
+            }
+            assert len(cfgs) == 1
+        finally:
+            if cli is not None:
+                cli.close()
+            for r in replicas:
+                try:
+                    r.stop()
+                except Exception:
+                    pass
+
+    def test_removal_guards(self, tmp_path):
+        """Removal is refused aimed at the leader, refused when the
+        shrunk group could not commit, and a second change is refused
+        while the first is in flight."""
+        replicas, endpoints = _spawn_group(tmp_path, 3, lease_ttl=1.0)
+        try:
+            assert _wait(
+                lambda: _roles(replicas).count("leader") == 1
+                and _roles(replicas).count("follower") == 2,
+                timeout=15.0,
+            ), _roles(replicas)
+            lidx = _roles(replicas).index("leader")
+            leader = replicas[lidx].mgr
+            with pytest.raises(ApiError,
+                               match="cannot remove the current leader"):
+                leader.remove_replica(endpoints[lidx])
+            # kill one follower: removing the OTHER (live) follower
+            # would leave [leader, corpse] — a group that cannot commit
+            dead = (lidx + 1) % 3
+            live = (lidx + 2) % 3
+            replicas[dead].kill()
+            with pytest.raises(ApiError, match="removal refused"):
+                leader.remove_replica(endpoints[live])
+            # the single-change discipline, tested at the seam
+            leader._begin_change("add tcp://x:1")
+            with pytest.raises(ApiError, match="already in flight"):
+                leader._begin_change("add tcp://y:1")
+            leader._end_change()
+            # removing the CORPSE is allowed: [leader, live] commits —
+            # with the flight recorder on, so the repl:membership span
+            # seam runs (zero-cost-off everywhere else)
+            from volcano_tpu import obs
+
+            obs.enable(replicas[lidx].store, identity="membership-test")
+            try:
+                res = leader.remove_replica(endpoints[dead])
+            finally:
+                obs.disable()
+            assert res["committed"]
+            assert endpoints[dead] not in res["endpoints"]
+        finally:
+            for i, r in enumerate(replicas):
+                try:
+                    r.stop()
+                except Exception:
+                    pass
+
+    def test_uncommitted_change_keeps_latch_until_commit(self, tmp_path):
+        """Appended-but-uncommitted keeps the single-change latch HELD
+        (a second change must not stack on an uncommitted base); once
+        the record commits, the next change request resolves the latch
+        and proceeds."""
+        replicas, endpoints = _spawn_group(tmp_path, 3, lease_ttl=1.0)
+        joiner = None
+        try:
+            assert _wait(
+                lambda: _roles(replicas).count("leader") == 1
+                and _roles(replicas).count("follower") == 2,
+                timeout=15.0,
+            ), _roles(replicas)
+            lidx = _roles(replicas).index("leader")
+            leader = replicas[lidx].mgr
+            assert _wait(
+                lambda: all(r.store.membership_config() is not None
+                            for r in replicas),
+                timeout=10.0,
+            )
+            port = _free_port()
+            url = f"tcp://127.0.0.1:{port}"
+            joiner = _Replica(str(tmp_path / "r3"), endpoints + [url],
+                              3, port, lease_ttl=1.0).start()
+            assert _wait(
+                lambda: leader.coordinator.catch_up_lag(url) == 0,
+                timeout=10.0,
+            )
+            # drop config shipments and shrink the commit wait so the
+            # add APPENDS but times out uncommitted
+            leader.coordinator.commit_timeout = 1.0
+            faults.configure("repl.config_drop=1")
+            with pytest.raises(ApiError, match="not yet committed"):
+                leader.add_replica(url)
+            # the latch survives the failed request: a second change is
+            # refused, not stacked on the uncommitted epoch-2 base
+            with pytest.raises(ApiError, match="already in flight"):
+                leader.remove_replica(endpoints[(lidx + 1) % 3])
+            # heal: shipments flow, the record commits, and the next
+            # change request resolves the latch against the commit
+            # point — a repeat add now reports "already a member"
+            # (the epoch-2 record committed; it is not re-appended)
+            faults.configure(None)
+            assert _wait(
+                lambda: leader.coordinator.commit_seq()
+                >= replicas[lidx].store.event_seq,
+                timeout=10.0,
+            )
+            with pytest.raises(ApiError, match="already a member"):
+                leader.add_replica(url)
+        finally:
+            faults.configure(None)
+            if joiner is not None:
+                joiner.stop()
+            for r in replicas:
+                try:
+                    r.stop()
+                except Exception:
+                    pass
+
+    def test_nonmember_replica_never_elects(self, tmp_path):
+        """A replica whose own log says it is not a voting member — a
+        learner awaiting admission, or a removed replica restarted with
+        its stale --replicas list — must never promote, even with the
+        leader dead and a probe majority visible (the zombie-leader
+        case)."""
+        store = PersistentAPIServer(str(tmp_path / "d"))
+        try:
+            mgr = ReplicaManager(
+                store,
+                ["tcp://127.0.0.1:1", "tcp://127.0.0.1:2",
+                 "tcp://127.0.0.1:3"],
+                0, lease_ttl=1.0,
+            )
+            # the committed config does NOT list this replica's url
+            store.log_membership({
+                "epoch": 2,
+                "endpoints": ["tcp://127.0.0.1:2", "tcp://127.0.0.1:3"],
+            })
+            assert mgr._elect() is None
+            assert mgr.role != "leader"
+        finally:
+            store.close()
+
+    def test_url_less_follower_votes_under_dynamic_config(self):
+        """Rolling-upgrade rule: a follower that never reported a url
+        (a pre-v7 peer) VOTES even once a membership config is adopted
+        — excluding it would wedge the quorum for the whole upgrade.
+        A follower with a KNOWN non-member url (learner) still never
+        counts."""
+        from volcano_tpu.bus.replication import ReplicationCoordinator
+
+        coord = ReplicationCoordinator(3, "leader", 0, 0)
+        coord.set_group(3, ["tcp://a:1", "tcp://b:1", "tcp://c:1"])
+        coord.leader_append(5, 1, 0, b"{}", 0.0)
+        assert coord.commit_seq() == 0
+        # a v7 learner (known url outside the config) acks: no commit
+        coord.ack("learner", 5, url="tcp://learner:1")
+        assert coord.commit_seq() == 0
+        # a pre-v7 follower (no url) acks: quorum of 2 reached
+        coord.ack("old-peer", 5)
+        assert coord.commit_seq() == 5
+        coord.shutdown()
+
+    def test_proxy_budget_covers_membership_ops(self):
+        """A follower's per-hop proxy budget for the membership ops
+        must cover the leader's legitimate catch-up + commit waits
+        (the remote client's own 30s budget) — the 4s election-scale
+        cap made a proxied add-replica time out while the change went
+        on to COMMIT at the leader."""
+        from volcano_tpu.bus.replication import proxy_timeout
+
+        assert proxy_timeout("bus_add_replica", 1.0) >= 30.0
+        assert proxy_timeout("bus_remove_replica", 1.0) >= 30.0
+        # ordinary writes keep the election-timescale bound
+        assert proxy_timeout("create", 1.0) == 4.0
+        assert proxy_timeout("create", 100.0) == 15.0
+
+    def test_removal_via_snapshot_stands_down(self, tmp_path):
+        """_note_shipped_config applies the SAME rule to records and
+        snapshots: a config that no longer lists a once-member replica
+        ends its follow episode (a removal can arrive via the snapshot
+        bootstrap — a down member removed while its log diverged — and
+        on a write-idle group no record would ever re-run the check)."""
+        store = PersistentAPIServer(str(tmp_path / "d"))
+        try:
+            mgr = ReplicaManager(
+                store,
+                ["tcp://127.0.0.1:1", "tcp://127.0.0.1:2"],
+                0, lease_ttl=1.0,
+            )
+            # admitted once...
+            store.log_membership({
+                "epoch": 1,
+                "endpoints": ["tcp://127.0.0.1:1", "tcp://127.0.0.1:2"],
+            })
+            assert mgr._note_shipped_config() is False
+            with mgr._lock:
+                assert mgr._was_member
+            # ...then a shipped config (record or snapshot) drops us
+            store.log_membership({
+                "epoch": 2, "endpoints": ["tcp://127.0.0.1:2"],
+            })
+            assert mgr._note_shipped_config() is True
+        finally:
+            store.close()
+
+    def test_lost_leader_clears_recorded_view(self, tmp_path):
+        """When a follow episode ends because the leader is provably
+        lost (unreachable past the TTL), the recorded leader view is
+        CLEARED — so proxies answer "no leader elected" and /healthz
+        degrades to below-quorum while the election runs, instead of
+        answering "ok" with a dead leader url."""
+        ttl = 0.8
+        replicas, endpoints = _spawn_group(tmp_path, 3, lease_ttl=ttl)
+        try:
+            assert _wait(
+                lambda: _roles(replicas).count("leader") == 1
+                and _roles(replicas).count("follower") == 2,
+                timeout=15.0,
+            ), _roles(replicas)
+            lidx = _roles(replicas).index("leader")
+            followers = [r for i, r in enumerate(replicas) if i != lidx]
+            # kill the leader AND one follower: the survivor cannot
+            # elect (no majority) and must clear its leader view
+            replicas[lidx].kill()
+            followers[0].kill()
+            survivor = followers[1]
+            assert _wait(
+                lambda: survivor.mgr.leader_url is None,
+                timeout=ttl * 10 + 10.0,
+            ), survivor.mgr.leader_url
+            assert survivor.mgr.role != "leader"
+        finally:
+            for r in replicas:
+                try:
+                    r.stop()
+                except Exception:
+                    pass
+
+    def test_add_refuses_url_that_never_catches_up(self, tmp_path):
+        replicas, endpoints = _spawn_group(tmp_path, 3, lease_ttl=1.0)
+        try:
+            assert _wait(
+                lambda: _roles(replicas).count("leader") == 1,
+                timeout=15.0,
+            ), _roles(replicas)
+            lidx = _roles(replicas).index("leader")
+            with pytest.raises(ApiError, match="never caught up"):
+                replicas[lidx].mgr.add_replica(
+                    f"tcp://127.0.0.1:{_free_port()}",
+                    catch_up_timeout=1.0,
+                )
+            # the refused change left NO config behind and cleared the
+            # in-flight latch (a retry is allowed)
+            assert replicas[lidx].store.membership_config()["epoch"] == 1
+            assert replicas[lidx].mgr._change_inflight is None
+        finally:
+            for r in replicas:
+                try:
+                    r.stop()
+                except Exception:
+                    pass
+
+
+class TestMembershipChaos:
+    def test_leader_killed_mid_config_change_one_surviving_config(
+        self, tmp_path
+    ):
+        """THE membership chaos drill: the leader is SIGKILLed while a
+        config change is appended-but-uncommitted (its shipment dropped
+        by ``repl.config_drop``).  The surviving majority elects, the
+        elected most-advanced log decides, and exactly ONE config
+        survives everywhere — with zero lost acknowledged writes."""
+        ttl = 1.0
+        replicas, endpoints = _spawn_group(tmp_path, 3, lease_ttl=ttl)
+        cli = None
+        joiner = None
+        lidx = -1
+        try:
+            assert _wait(
+                lambda: _roles(replicas).count("leader") == 1
+                and _roles(replicas).count("follower") == 2,
+                timeout=15.0,
+            ), _roles(replicas)
+            lidx = _roles(replicas).index("leader")
+            fidx = (lidx + 1) % 3
+            cli = RemoteAPIServer(endpoints[fidx])
+            assert cli.wait_ready(10)
+            cli.create(_cm("acked-before"))
+            # wait for the epoch-1 seed to ship BEFORE arming the drop
+            # (a dropped seed would wedge every follower's cursor)
+            assert _wait(
+                lambda: all(r.store.membership_config() is not None
+                            for r in replicas),
+                timeout=10.0,
+            )
+
+            port = _free_port()
+            url = f"tcp://127.0.0.1:{port}"
+            joiner = _Replica(str(tmp_path / "r3"), endpoints + [url],
+                              3, port, lease_ttl=ttl).start()
+            # wait until the learner has pulled level (lag provable 0)
+            # so add_replica passes the catch-up gate immediately and
+            # parks on the COMMIT wait — the window we kill into
+            assert _wait(
+                lambda: replicas[lidx].mgr.coordinator is not None
+                and replicas[lidx].mgr.coordinator.catch_up_lag(url) == 0,
+                timeout=10.0,
+            )
+            faults.configure("repl.config_drop=1")
+            add_err = []
+
+            def _add():
+                try:
+                    replicas[lidx].mgr.add_replica(url)
+                except ApiError as e:
+                    add_err.append(str(e))
+
+            t = threading.Thread(target=_add, daemon=True)
+            t.start()
+            # the config record is appended (epoch 2 on the leader) but
+            # its shipments are dropped — no follower holds it
+            assert _wait(
+                lambda: replicas[lidx].store.membership_config()["epoch"]
+                == 2,
+                timeout=10.0,
+            )
+            replicas[lidx].kill()
+            faults.configure(None)
+            t.join(timeout=15)
+            assert t.is_alive() is False
+
+            # a survivor of the OLD config promotes (2/3 majority)
+            assert _wait(
+                lambda: _roles(replicas, skip=(lidx,)).count("leader")
+                == 1,
+                timeout=25.0,
+            ), _roles(replicas, skip=(lidx,))
+            survivors = [r for i, r in enumerate(replicas) if i != lidx]
+            # exactly one surviving config: the uncommitted epoch-2
+            # record died with the leader's log — every live replica
+            # (joiner included) agrees on epoch 1
+            def one_config():
+                cfgs = {
+                    tuple(r.store.membership_config()["endpoints"])
+                    for r in survivors + [joiner]
+                    if r.store.membership_config() is not None
+                }
+                return len(cfgs) == 1
+            assert _wait(one_config, timeout=15.0)
+            cfg = survivors[0].store.membership_config()
+            assert cfg["epoch"] == 1
+            assert cfg["endpoints"] == endpoints
+            # zero lost acknowledged writes, no split-brain
+            for r in survivors:
+                assert _wait(
+                    lambda r=r: r.store.get(
+                        "ConfigMap", "ns", "acked-before"
+                    ) is not None,
+                    timeout=10.0,
+                )
+            assert _roles(replicas, skip=(lidx,)).count("leader") == 1
+        finally:
+            faults.configure(None)
+            if cli is not None:
+                cli.close()
+            if joiner is not None:
+                joiner.stop()
+            for i, r in enumerate(replicas):
+                if i == lidx:
+                    continue
+                try:
+                    r.stop()
+                except Exception:
+                    pass
+
+
+class TestPreVote:
+    def test_partitioned_rejoiner_cannot_depose_stable_leader(
+        self, tmp_path
+    ):
+        """THE pre-vote pin: a follower partitioned from the leader —
+        but NOT from the other follower (the asymmetric case the
+        reachable-majority floor cannot catch) — probes, collects
+        denials, and goes back to retrying WITHOUT incrementing the
+        term.  The stable leader's term never advances; the healed
+        rejoiner re-attaches at the same term."""
+        ttl = 0.8
+        replicas, endpoints = _spawn_group(tmp_path, 3, lease_ttl=ttl)
+        cli = None
+        try:
+            assert _wait(
+                lambda: _roles(replicas).count("leader") == 1
+                and _roles(replicas).count("follower") == 2,
+                timeout=15.0,
+            ), _roles(replicas)
+            lidx = _roles(replicas).index("leader")
+            term0 = replicas[lidx].store.term
+            cli = RemoteAPIServer(endpoints[lidx])
+            assert cli.wait_ready(10)
+            cli.create(_cm("w0"))
+
+            vidx = (lidx + 1) % 3
+            replicas[vidx].mgr.block_peer(endpoints[lidx])
+            replicas[lidx].mgr.block_peer(endpoints[vidx])
+
+            # hold the partition for several TTLs of election attempts
+            # while writes keep landing through the leader
+            t_end = time.monotonic() + ttl * 4
+            i = 1
+            while time.monotonic() < t_end:
+                cli.create(_cm(f"w{i}"))
+                i += 1
+                time.sleep(0.1)
+
+            assert replicas[lidx].mgr.role == "leader"
+            assert replicas[lidx].store.term == term0, (
+                f"stable leader's term advanced {term0} -> "
+                f"{replicas[lidx].store.term}"
+            )
+            assert replicas[vidx].mgr.role != "leader"
+
+            # heal: the rejoiner re-attaches and catches up, SAME term
+            replicas[vidx].mgr.unblock_peer(endpoints[lidx])
+            replicas[lidx].mgr.unblock_peer(endpoints[vidx])
+            assert _wait(
+                lambda: replicas[vidx].mgr.role == "follower"
+                and replicas[vidx].store.get("ConfigMap", "ns", "w1")
+                is not None,
+                timeout=15.0,
+            )
+            assert replicas[lidx].store.term == term0
+        finally:
+            if cli is not None:
+                cli.close()
+            for r in replicas:
+                try:
+                    r.stop()
+                except Exception:
+                    pass
+
+    def test_prevote_answer_semantics(self, tmp_path):
+        """handle_prevote grants only to (not-leader, no proven leader
+        contact within TTL, candidate log >= mine)."""
+        store = PersistentAPIServer(str(tmp_path / "d"))
+        try:
+            mgr = ReplicaManager(
+                store, ["tcp://127.0.0.1:1", "tcp://127.0.0.1:2"], 1,
+                lease_ttl=1.0,
+            )
+            store.create(_cm("x"))  # seq 1
+            # no leader contact ever, candidate at least as advanced
+            # (the election's candidate_rank ordering: lowest index
+            # wins ties): grant
+            resp = mgr.handle_prevote(
+                {"term": 0, "seq": store.event_seq, "index": 0}
+            )
+            assert resp["granted"] is True
+            # a candidate with a SHORTER log is denied (its promotion
+            # would erase what we hold)
+            resp = mgr.handle_prevote({"term": 0, "seq": 0, "index": 0})
+            assert resp["granted"] is False
+            # proven leader contact within the TTL: deny everyone —
+            # this is the clause that stops a partitioned rejoiner
+            with mgr._lock:
+                mgr._leader_heard = time.monotonic()
+            resp = mgr.handle_prevote(
+                {"term": 9, "seq": 99, "index": 0}
+            )
+            assert resp["granted"] is False
+            # a leader always denies
+            with mgr._lock:
+                mgr._leader_heard = 0.0
+                mgr.role = "leader"
+            resp = mgr.handle_prevote({"term": 9, "seq": 99, "index": 0})
+            assert resp["granted"] is False
+        finally:
+            store.close()
+
+
+class TestLeaderHint:
+    def test_not_leader_error_round_trips_with_hint(self):
+        from volcano_tpu.bus.protocol import (
+            NotLeaderError,
+            error_payload,
+            raise_error,
+        )
+
+        payload = error_payload(
+            NotLeaderError("not leader", leader="tcp://h:7180")
+        )
+        assert payload["error"] == "NotLeaderError"
+        assert payload["leader"] == "tcp://h:7180"
+        with pytest.raises(NotLeaderError) as ei:
+            raise_error(payload)
+        assert ei.value.leader == "tcp://h:7180"
+        # hint-less form stays a plain ApiError payload (no key)
+        assert "leader" not in error_payload(ApiError("boom"))
+
+    def test_client_knowing_only_follower_lands_leader_op(self, tmp_path):
+        """The redial pin: a client whose endpoint list holds ONLY a
+        follower registers an admission hook (a leader-only op).  The
+        follower's ``not leader`` answer carries the leader endpoint;
+        the client steers its cursor there, redials DIRECTLY, and the
+        resync replays the registration at the leader."""
+        replicas, endpoints = _spawn_group(tmp_path, 3, lease_ttl=1.0)
+        cli = None
+        try:
+            assert _wait(
+                lambda: _roles(replicas).count("leader") == 1
+                and _roles(replicas).count("follower") == 2,
+                timeout=15.0,
+            ), _roles(replicas)
+            lidx = _roles(replicas).index("leader")
+            fidx = (lidx + 1) % 3
+            cli = RemoteAPIServer(endpoints[fidx])  # follower ONLY
+            assert cli.wait_ready(10)
+
+            from volcano_tpu.client.apiserver import AdmissionError
+
+            def deny(operation, obj):
+                raise AdmissionError("denied by hook")
+
+            cli.register_admission("ConfigMap", "CREATE", deny)
+            # the hint appended the leader endpoint and the redial
+            # landed there — the registration is live group-wide
+            assert _wait(
+                lambda: endpoints[lidx] in cli.endpoints,
+                timeout=10.0,
+            ), cli.endpoints
+            def denied():
+                try:
+                    cli.create(_cm("should-deny"))
+                    return False
+                except ApiError as e:
+                    return "denied by hook" in str(e)
+            assert _wait(denied, timeout=15.0)
+        finally:
+            if cli is not None:
+                cli.close()
+            for r in replicas:
+                try:
+                    r.stop()
+                except Exception:
+                    pass
+
+
+class TestHealthzDegradedReplication:
+    def _daemon(self, tmp_path, n=3):
+        from volcano_tpu.cmd.apiserver import ApiServerDaemon
+
+        endpoints = [f"tcp://127.0.0.1:{7180 + i}" for i in range(n)]
+        return ApiServerDaemon(
+            data_dir=str(tmp_path / "d"),
+            replicas=endpoints,
+            replica_index=0,
+            repl_lease_ttl=1.0,
+        ), endpoints
+
+    def test_below_quorum_and_replica_lagging(self, tmp_path):
+        from volcano_tpu.bus.replication import ReplicationCoordinator
+
+        daemon, endpoints = self._daemon(tmp_path)
+        try:
+            rep = daemon.replica
+            # follower that cannot name a leader: below-quorum
+            with rep._lock:
+                rep.role = "follower"
+                rep.leader_url = None
+            assert daemon._degraded() == "below-quorum"
+            # leader with no live voter: below-quorum
+            coord = ReplicationCoordinator(3, "apiserver-0", 0, 0)
+            with rep._lock:
+                rep.role = "leader"
+                rep.coordinator = coord
+            assert daemon._degraded() == "below-quorum"
+            # quorum holds, worst live voter lags past the bar
+            coord.leader_append(1000, 1, 0, b"{}", 0.0)
+            coord.ack("apiserver-1", 1000 - 600, url=endpoints[1])
+            assert daemon._degraded() == "replica-lagging"
+            # healthy: quorum + bounded lag -> None
+            coord.ack("apiserver-1", 1000, url=endpoints[1])
+            assert daemon._degraded() is None
+            coord.shutdown()
+        finally:
+            daemon.api.close()
+
+
 class TestHaMetrics:
     def test_wal_and_repl_metrics_export(self, tmp_path):
         api = PersistentAPIServer(str(tmp_path / "d"))
@@ -681,6 +1475,24 @@ class TestHaMetrics:
         assert "volcano_repl_lag_entries 3" in text
         assert 'volcano_repl_role{role="leader"} 1' in text
         assert 'volcano_bus_recoveries_total{kind="wal_tail"}' in text
+
+    def test_membership_epoch_exports(self, tmp_path):
+        d = str(tmp_path / "d")
+        api = PersistentAPIServer(d)
+        api.log_membership({"epoch": 3, "endpoints": ["tcp://h:1"]})
+        text = metrics.registry.render()
+        assert "volcano_repl_membership_epoch 3" in text
+        api.close()
+        # recovery re-exports the recovered epoch
+        metrics.update_membership_epoch(0)
+        rec = PersistentAPIServer(d)
+        assert "volcano_repl_membership_epoch 3" in metrics.registry.render()
+        rec.close()
+        # the "removed" role is part of the bounded one-hot vocabulary
+        metrics.update_repl_role("removed")
+        assert ('volcano_repl_role{role="removed"} 1'
+                in metrics.registry.render())
+        metrics.update_repl_role("init")
 
 
 # ---- slow: rolling leader kills across real OS processes ----

@@ -424,6 +424,22 @@ class TestShardedFederationRendering:
         assert args.shard_identity == "volcano-tpu-scheduler-1"
         assert args.bus == BUS_URL
 
+    def test_shard_autoscale_flag_renders_on_every_member(self):
+        values = apply_set(DEFAULT_VALUES, "scheduler.shards=2")
+        values = apply_set(values, "scheduler.shard_autoscale=true")
+        manifests = dict(render(values))
+        for i in range(2):
+            dep = manifests[f"30-scheduler-{i}-deployment.yaml"]
+            cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
+            assert cmd[cmd.index("--shard-autoscale") + 1] == "on"
+        # off by default: the static fleet stays static
+        plain = dict(render(apply_set(DEFAULT_VALUES,
+                                      "scheduler.shards=2")))
+        for i in range(2):
+            dep = plain[f"30-scheduler-{i}-deployment.yaml"]
+            cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
+            assert "--shard-autoscale" not in cmd
+
     def test_shards_off_output_unchanged(self):
         # shards=0 (the default) must render exactly the classic
         # topology — the pinned static manifest stays valid

@@ -1240,6 +1240,10 @@ class TestVtctlShards:
         api = APIServer()
         rec = {
             "nShards": 2,
+            "autoscale": {"enabled": True, "target": 2,
+                          "lastChange": 1000.0, "direction": "up",
+                          "reason": "p99=900ms pending=40 members=1",
+                          "decisions": 1},
             "members": {"m0": {"heartbeat": 1000.0,
                                "leaseDurationSeconds": 2.0}},
             "shards": {
@@ -1276,6 +1280,9 @@ class TestVtctlShards:
         assert "<unheld>" in direct.getvalue()
         # the gang-assembly line renders from the stats blob alone
         assert "gang-assembly: committed=1 conflict=2" in direct.getvalue()
+        # the autoscale line renders from stored fields alone — it is
+        # part of the byte-identity assertion above
+        assert "Autoscale:          target 2 (up:" in direct.getvalue()
 
     def test_shards_without_map(self):
         import io

@@ -74,10 +74,21 @@ MAGIC = b"VBUS"
 #: v5 peer cannot apply half a gang atomically, so the client reports
 #: the whole transaction unsupported and the gang broker stays in the
 #: honest pre-v6 refusal mode — version skew costs the cross-shard
-#: gang feature, never the no-partial-gang invariant).
+#: gang feature, never the no-partial-gang invariant).  v7 adds the
+#: elastic-membership surface: ``repl_prevote`` (a candidate probes
+#: whether peers would support its promotion BEFORE incrementing the
+#: term — a partitioned rejoiner can no longer depose a healthy
+#: leader) and the dynamic-membership ops ``bus_add_replica`` /
+#: ``bus_remove_replica`` (one replica at a time through a
+#: WAL-recorded, replicated membership-config record).  A pre-v7 peer
+#: answers ``unknown bus op``: the membership ops then fail with a
+#: typed "dynamic membership unsupported" error (no fallback CAN exist
+#: — an old peer has no config log to record the change in), and a
+#: pre-vote that cannot be asked counts as a denial (safety over
+#: liveness; an old peer cannot be a v7 replica anyway).
 #: VERSION is the protocol revision this build speaks; receivers
 #: accept [MIN_VERSION, VERSION].
-VERSION = 6
+VERSION = 7
 #: oldest frame version this build still decodes — and the version
 #: outgoing frames carry, since the layout has not changed since v1
 MIN_VERSION = 1
@@ -142,9 +153,13 @@ OP_VERSIONS: Dict[str, int] = {
     "repl_snapshot": 5,
     "repl_commit": 5,
     "txn_commit": 6,
+    "repl_prevote": 7,
+    "bus_add_replica": 7,
+    "bus_remove_replica": 7,
 }
 
-#: wire error name → exception class; unknown names fall back to ApiError
+#: wire error name → exception class; unknown names fall back to ApiError.
+#: NotLeaderError (defined below) registers itself after its definition.
 ERRORS: Dict[str, type] = {
     cls.__name__: cls
     for cls in (
@@ -163,6 +178,22 @@ class BusError(ApiError):
 
 class BusTimeoutError(BusError):
     """A request did not complete within its per-call timeout."""
+
+
+class NotLeaderError(ApiError):
+    """A write (or leader-only op) landed on a replica that cannot take
+    it.  ``leader`` carries the answering replica's current leader view
+    (``tcp://host:port``, or None mid-election) so the client can redial
+    the leader DIRECTLY instead of rotating the endpoint list blindly —
+    the structured form of the ``"not leader"`` message-sniffing the
+    failover drill used to pay a full rotation for."""
+
+    def __init__(self, message: str, leader: Optional[str] = None):
+        super().__init__(message)
+        self.leader = leader
+
+
+ERRORS[NotLeaderError.__name__] = NotLeaderError
 
 
 def encode_obj(obj) -> Optional[dict]:
@@ -185,11 +216,20 @@ def error_payload(exc: Exception) -> dict:
     name = type(exc).__name__
     if name not in ERRORS:
         name = "ApiError"
-    return {"error": name, "message": str(exc)}
+    out = {"error": name, "message": str(exc)}
+    leader = getattr(exc, "leader", None)
+    if leader:
+        # the leader-hint channel: a follower answering "not leader"
+        # names the leader so the client's next dial is direct
+        out["leader"] = leader
+    return out
 
 
 def raise_error(payload: dict) -> None:
     cls = ERRORS.get(payload.get("error", ""), ApiError)
+    if cls is NotLeaderError:
+        raise NotLeaderError(payload.get("message", "remote error"),
+                             leader=payload.get("leader"))
     raise cls(payload.get("message", "remote error"))
 
 

@@ -162,6 +162,9 @@ class RemoteAPIServer:
         #: leader) — on connect to a follower it redials at the
         #: follower-reported leader address
         self._must_lead = False
+        #: monotonic stamp of the last leader-hint redial — one hint
+        #: mid-election must not turn into a redial storm
+        self._last_hint_redial = 0.0
 
         self._ctl: "queue.Queue[tuple]" = queue.Queue()
         self._dispatch_q: "queue.Queue[Optional[tuple]]" = queue.Queue()
@@ -460,9 +463,47 @@ class RemoteAPIServer:
             raise waiter["error"]
         if waiter["error_payload"] is not None:
             metrics.observe_bus_request(method, time.perf_counter() - start, "error")
+            leader = waiter["error_payload"].get("leader")
+            if leader:
+                # leader-hint redial: a "not leader" answer NAMES the
+                # current leader — steer the endpoint cursor there and
+                # reconnect directly instead of rotating the list
+                # blindly (each blind rotation costs a dial + probe;
+                # the hint collapses the failover/proxy tail to one
+                # reconnect).  Debounced: one hint per second at most.
+                self._note_leader(leader)
             protocol.raise_error(waiter["error_payload"])
         metrics.observe_bus_request(method, time.perf_counter() - start, "ok")
         return waiter["result"]
+
+    def _note_leader(self, leader: str) -> None:
+        """Point the endpoint cursor at a hinted leader so the NEXT
+        dial — a reconnect after a failure, or a ``_must_lead``
+        leader-chase — goes straight there instead of rotating the
+        list blindly.  Mirrors ``_leader_check``'s cursor discipline
+        (benign races: worst case the list briefly holds a duplicate
+        entry).
+
+        Only a ``_must_lead`` client redials IMMEDIATELY: for everyone
+        else the hinted write already failed typed and the caller's
+        retry flows through the live connection once the proxy heals.
+        Tearing down a healthy follower connection on every hint was
+        worse than blind rotation — mid-failover the hint names the
+        JUST-DEAD leader (the follower's stale view), and the
+        pointless redial both pays a dead dial and forces the watch
+        resume onto whatever epoch the reconnect lands on (the
+        zero-relist failover pin caught exactly that churn)."""
+        if leader not in self.endpoints:
+            self.endpoints.append(leader)
+        self._endpoint_idx = self.endpoints.index(leader)
+        now = time.monotonic()
+        if (
+            self._must_lead
+            and leader != self.address
+            and now - self._last_hint_redial >= 1.0
+        ):
+            self._last_hint_redial = now
+            self._ctl.put(("redial",))
 
     def _send_noreply(self, mtype: int, corr_id: int, payload: dict) -> None:
         sock = self._sock
@@ -504,6 +545,41 @@ class RemoteAPIServer:
                 )
                 self._no_bus_status = True
         return {"role": "unknown", "persistent": False}
+
+    def _membership_call(self, op: str, url: str, verb: str) -> dict:
+        """Shared driver for the VBUS v7 membership ops.  Routed to the
+        leader (a follower proxies).  A pre-v7 server answers ``unknown
+        bus op``: dynamic membership then fails with a typed error — no
+        fallback CAN exist, an old peer has no membership log to record
+        the change in (version skew costs the elastic feature, never
+        group safety)."""
+        try:
+            # the leader may wait for a joiner's catch-up (or probe the
+            # shrunk group's reachability) before logging the config
+            # record — give it room beyond the default per-call budget
+            return self._call({"op": op, "url": url},
+                              timeout=max(self.timeout, 30.0))
+        except BusError:
+            raise  # transport failure — NOT a capability signal
+        except ApiError as e:
+            if "unknown bus op" not in str(e):
+                raise
+            raise ApiError(
+                "bus does not support dynamic membership (pre-v7 "
+                f"peer) — {verb} refused"
+            ) from e
+
+    def bus_add_replica(self, url: str) -> dict:
+        """Admit one new replica to the replication group (protocol v7;
+        ``vtctl bus add-replica``)."""
+        return self._membership_call("bus_add_replica", url,
+                                     "add-replica")
+
+    def bus_remove_replica(self, url: str) -> dict:
+        """Retire one replica from the replication group (protocol v7;
+        ``vtctl bus remove-replica``)."""
+        return self._membership_call("bus_remove_replica", url,
+                                     "remove-replica")
 
     def create(self, obj):
         resp = self._call({"op": "create", "object": protocol.encode_obj(obj)})

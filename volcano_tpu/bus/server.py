@@ -595,22 +595,31 @@ class BusServer:
     _LEADER_OPS = frozenset({
         "create", "update", "update_status", "delete",
         "cas_bind", "commit_batch", "txn_commit", "get",
+        "bus_add_replica", "bus_remove_replica",
     })
 
     def _execute(self, conn: _Conn, req_id: int, payload: dict, op: str):
+        from volcano_tpu.bus.protocol import NotLeaderError
+
         api = self.api
         replica = self.replica
         if replica is not None and not replica.is_leader:
             if op in self._LEADER_OPS:
                 if payload.get("proxied"):
                     # one-hop cap: our leader view is stale — tell the
-                    # proxying peer instead of bouncing frames around
-                    raise ApiError("not leader (proxied write refused)")
+                    # proxying peer instead of bouncing frames around;
+                    # the hint carries OUR leader view so the caller's
+                    # next dial is direct, not a blind rotation
+                    raise NotLeaderError(
+                        "not leader (proxied write refused)",
+                        leader=replica.leader_url,
+                    )
                 return replica.proxy(payload)
             if op == "register_admission":
-                raise ApiError(
+                raise NotLeaderError(
                     "not leader — register_admission must run at the "
-                    f"leader ({replica.leader_url or 'unknown'})"
+                    f"leader ({replica.leader_url or 'unknown'})",
+                    leader=replica.leader_url,
                 )
         if op == "bus_status":
             from volcano_tpu.bus.wal import bus_status_payload
@@ -628,6 +637,21 @@ class BusServer:
             if replica is None:
                 raise ApiError("replication not enabled")
             return replica.handle_commit(payload)
+        if op == "repl_prevote":
+            if replica is None:
+                raise ApiError("replication not enabled")
+            # served by ANY role: a pre-vote probe asks "would you
+            # support my promotion", which followers (and the leader,
+            # who always denies) answer locally
+            return replica.handle_prevote(payload)
+        if op == "bus_add_replica":
+            if replica is None:
+                raise ApiError("replication not enabled")
+            return replica.add_replica(str(payload.get("url", "")))
+        if op == "bus_remove_replica":
+            if replica is None:
+                raise ApiError("replication not enabled")
+            return replica.remove_replica(str(payload.get("url", "")))
         if op == "create":
             obj = protocol.decode_obj(payload["object"])
             obj = self._remote_admission(obj.kind, "CREATE", obj)

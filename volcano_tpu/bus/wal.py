@@ -194,6 +194,13 @@ class PersistentAPIServer(APIServer):
         self._wal_size = 0  # guarded-by: self._lock
         self.last_fsync_ts = 0.0  # guarded-by: self._lock
         self.last_fsync_ms = 0.0  # guarded-by: self._lock
+        #: replication-group membership config, or None before the
+        #: group's first leader seeded it: ``{"epoch": int,
+        #: "endpoints": [url, ...]}``.  Lives in the LOG (one
+        #: membership record per change, replicated and recovered like
+        #: any transaction) so after any crash/partition exactly one
+        #: config survives — the one on the most advanced elected log.
+        self.membership: Optional[dict] = None  # guarded-by: self._lock
         #: follower guard: public mutating ops are refused while this
         #: store replicates from a leader (writes arrive only through
         #: apply_replica_record / install_snapshot)
@@ -316,6 +323,8 @@ class PersistentAPIServer(APIServer):
             self.epoch = snap["epoch"]
         if int(snap.get("term", 0)) > self.term:
             self.term = int(snap["term"])
+        if snap.get("membership") is not None:
+            self.membership = dict(snap["membership"])
         self._recent = list(snap.get("backlog", []))
 
     def _ingest_record(self, rec: dict, payload: bytes,
@@ -327,6 +336,22 @@ class PersistentAPIServer(APIServer):
         recovered and replicated stores diverge."""
         # requires-lock: self._lock
         ts = rec.get("ts", 0.0)
+        if "membership" in rec:
+            # a membership-config record: no store events, ONE synthetic
+            # slot in the event-seq space (so replication cursors move
+            # past it and the CRC chain covers it), config applied at
+            # APPEND time — the Raft latest-config-in-log rule, which is
+            # what makes "exactly one surviving config" hold when a
+            # leader dies mid-change: the elected most-advanced log
+            # decides, and every replica replays the same record
+            self.event_seq += 1
+            self.membership = dict(rec["membership"])
+            self.chain = zlib.crc32(payload, self.chain)
+            self._records_since_snapshot += 1
+            metrics.update_membership_epoch(
+                int(self.membership.get("epoch", 0))
+            )
+            return
         for kind, event, old_d, new_d in rec["events"]:
             self.event_seq += 1
             self._apply_event_physical(kind, event, old_d, new_d)
@@ -513,6 +538,47 @@ class PersistentAPIServer(APIServer):
         with self._txn():
             return super().txn_commit(binds=binds)
 
+    # ---- membership-config records (bus/replication.py) ----
+
+    def membership_config(self) -> Optional[dict]:
+        """The latest membership config applied to this log (None until
+        the group's first leader seeds one)."""
+        with self._lock:
+            return dict(self.membership) if self.membership else None
+
+    def log_membership(self, membership: dict) -> int:
+        """Append ONE membership-config WAL record and hand it to the
+        replication outbox.  Returns the record's event seq; the CALLER
+        (the ReplicaManager, which owns the single-change discipline)
+        re-counts the quorum under the new config and waits for the
+        commit — appending and waiting are split exactly so the config
+        can take effect at append time (``_ingest_record``'s rule).  A
+        failed append (``wal.write_fail``) applies nothing."""
+        with self._lock:
+            fp = _get_fault_plane()
+            record = {
+                "membership": dict(membership),
+                "seq0": self.event_seq,
+                "term": self.term,
+                "ts": time.time(),
+            }
+            payload = json.dumps(record, separators=(",", ":")).encode()
+            self._append_wal(payload, fp)  # raises WalError → no change
+            self.chain = zlib.crc32(payload, self.chain)
+            self.event_seq += 1
+            self.membership = dict(membership)
+            self._records_since_snapshot += 1
+            if self.replicator is not None:
+                self.replicator.leader_append(
+                    self.event_seq, self.term, self.chain, payload,
+                    record["ts"], config=True,
+                )
+            seq = self.event_seq
+            if self._records_since_snapshot >= self.snapshot_every:
+                self._write_snapshot()
+        metrics.update_membership_epoch(int(membership.get("epoch", 0)))
+        return seq
+
     # ---- commit path ----
 
     def _commit_txn(self, events: List[tuple]) -> int:
@@ -665,6 +731,9 @@ class PersistentAPIServer(APIServer):
             "rv": self._rv,
             "seq": self.event_seq,
             "chain": self.chain,
+            "membership": (
+                dict(self.membership) if self.membership else None
+            ),
             "objects": {
                 kind: {key: obj.to_dict() for key, obj in bucket.items()}
                 for kind, bucket in self._store.items() if bucket
@@ -787,6 +856,12 @@ class PersistentAPIServer(APIServer):
                 "snapshot_seq": self._snapshot_seq,
                 "last_fsync_ts": self.last_fsync_ts,
                 "last_fsync_ms": self.last_fsync_ms,
+                **({
+                    "membership_epoch": int(self.membership.get("epoch", 0)),
+                    "membership": sorted(
+                        self.membership.get("endpoints", ())
+                    ),
+                } if self.membership else {}),
                 **({"metrics_address": self.metrics_address}
                    if getattr(self, "metrics_address", "") else {}),
             }

@@ -411,6 +411,17 @@ def _shards(vc: VolcanoClient, args, out) -> int:
         return 1
     n = int(rec.get("nShards", 0))
     print(f"Shards:             {n}", file=out)
+    scale = rec.get("autoscale")
+    if scale:
+        # the autoscaler's last committed decision — stored fields
+        # only, so the line stays byte-identical across backends
+        print(
+            f"Autoscale:          target {scale.get('target', n)} "
+            f"({scale.get('direction', '?')}: "
+            f"{scale.get('reason', '')}; "
+            f"decisions {scale.get('decisions', 0)})",
+            file=out,
+        )
     print(f"  {'SHARD':<7}{'HOLDER':<22}{'LEASE':<8}{'RENEWED':<20}", file=out)
     for i in range(n):
         entry = rec.get("shards", {}).get(str(i), {})
@@ -484,6 +495,10 @@ def _bus_status(vc: VolcanoClient, args, out) -> int:
         return 0
     print(f"Epoch:              {st.get('epoch', '')}", file=out)
     print(f"Term:               {st.get('term', 0)}", file=out)
+    if "membership_epoch" in st:
+        members = ", ".join(st.get("membership", ()))
+        print(f"Membership:         epoch {st['membership_epoch']} "
+              f"[{members}]", file=out)
     print(f"Applied seq:        {st.get('seq', 0)}", file=out)
     if "commit_seq" in st:
         print(f"Committed seq:      {st['commit_seq']}", file=out)
@@ -510,6 +525,33 @@ def _bus_status(vc: VolcanoClient, args, out) -> int:
     elif st.get("role") == "leader" and int(st.get("replicas", 1)) > 1:
         print("Followers:          <none attached>", file=out)
     return 0
+
+
+def _bus_membership_change(vc: VolcanoClient, args, out, what: str) -> int:
+    """Shared driver for ``bus add-replica`` / ``bus remove-replica``:
+    sends the VBUS v7 membership op (the server routes it to the
+    leader) and renders the committed config."""
+    api = vc.api
+    method = getattr(api, f"bus_{what}_replica", None)
+    if method is None:
+        # the in-process backend has no replication group to change
+        print("error: dynamic membership needs a replicated bus — "
+              "connect with --bus tcp://...", file=out)
+        return 1
+    res = method(args.url)
+    members = "\n".join(f"  {u}" for u in res.get("endpoints", ()))
+    print(f"membership change committed at seq {res.get('seq', 0)} "
+          f"(epoch {res.get('epoch', 0)}):", file=out)
+    print(members, file=out)
+    return 0
+
+
+def _bus_add_replica(vc: VolcanoClient, args, out) -> int:
+    return _bus_membership_change(vc, args, out, "add")
+
+
+def _bus_remove_replica(vc: VolcanoClient, args, out) -> int:
+    return _bus_membership_change(vc, args, out, "remove")
 
 
 # ---- trace subcommands (volcano_tpu/trace) ----
@@ -928,8 +970,26 @@ def build_parser() -> argparse.ArgumentParser:
     bus_p.add_parser(
         "status",
         description="role, leader identity, term, WAL/snapshot sizes, "
-        "fsync stats, per-follower replication lag",
+        "fsync stats, per-follower replication lag, membership epoch",
     )
+    bus_add = bus_p.add_parser(
+        "add-replica",
+        description="admit ONE new replica to the running replication "
+        "group (dynamic membership, VBUS v7): start the new "
+        "vtpu-apiserver with --replicas listing the whole new group "
+        "(itself last) so it catches up as a learner, then run this — "
+        "the leader logs a replicated membership record once the "
+        "joiner's lag has closed",
+    )
+    bus_add.add_argument("url", help="the joiner's bus endpoint "
+                         "(tcp://host:port)")
+    bus_rm = bus_p.add_parser(
+        "remove-replica",
+        description="retire ONE replica from the running group; "
+        "refused when the remaining members could not commit a write "
+        "(reachable-majority floor) or when aimed at the leader",
+    )
+    bus_rm.add_argument("url", help="the retiring replica's bus endpoint")
 
     trace_p = sub.add_parser(
         "trace", description="cycle journal: record, replay, diff, export"
@@ -1061,6 +1121,8 @@ _HANDLERS = {
     ("shards", None): _shards,
     ("top", None): _top,
     ("bus", "status"): _bus_status,
+    ("bus", "add-replica"): _bus_add_replica,
+    ("bus", "remove-replica"): _bus_remove_replica,
     ("faults", "validate"): _faults_validate,
     ("trace", "record"): _trace_record,
     ("trace", "replay"): _trace_replay,

@@ -116,6 +116,7 @@ class ApiServerDaemon:
             host=listen_host, port=listen_port,
             health_check=lambda: self.bus.running,
             debug_enabled=debug_enabled,
+            degraded_source=self._degraded,
         )
         #: synthetic node pool + default queue on startup (idempotent).
         #: A real cluster's nodes arrive from kubelets; the standalone
@@ -126,6 +127,42 @@ class ApiServerDaemon:
         self.seed_nodes = seed_nodes
         self.seed_node_cpu = seed_node_cpu
         self.seed_node_mem = seed_node_mem
+
+    #: a live voter lagging more than this many entries degrades the
+    #: leader's /healthz (still 200 — the daemon serves; the body flags
+    #: that a failover NOW would pay a snapshot resync)
+    REPL_LAG_DEGRADED = 512
+
+    def _degraded(self) -> Optional[str]:
+        """``/healthz`` degraded body: replication state first (the
+        breaker-registry convention every daemon follows — degraded,
+        not dead), breaker registry second.
+
+        * ``degraded: below-quorum`` — a leader that could not commit a
+          write right now (live voters < quorum), or a follower that
+          cannot name a leader (mid-election / partitioned): writes
+          through this replica stall either way.
+        * ``degraded: replica-lagging`` — quorum holds but the worst
+          live voter trails by > REPL_LAG_DEGRADED entries.
+        """
+        rep = self.replica
+        if rep is not None:
+            with rep._lock:  # noqa: SLF001 — same-package status read
+                role = rep.role
+                coord = rep.coordinator
+                leader = rep.leader_url
+            if role == "leader" and coord is not None:
+                health = coord.quorum_health(rep.lease_ttl)
+                if health["live"] < health["quorum"]:
+                    return "below-quorum"
+                if health["max_lag"] > self.REPL_LAG_DEGRADED:
+                    return "replica-lagging"
+            elif role in ("follower", "init") and leader is None:
+                return "below-quorum"
+        from volcano_tpu.faults.breaker import degraded_reasons
+
+        reasons = degraded_reasons()
+        return ", ".join(reasons) if reasons else None
 
     def _seed_if_configured(self) -> None:
         if self.seed_nodes <= 0:

@@ -47,6 +47,7 @@ class SchedulerDaemon(BaseDaemon):
         shard_identity: str = "",
         shard_lease_duration: float = 2.0,
         gang_broker: bool = True,
+        shard_autoscale=None,
         **daemon_kw,
     ):
         # /explain reads self.cache lazily (set right below) — the
@@ -82,6 +83,7 @@ class SchedulerDaemon(BaseDaemon):
                 scheduler_name=scheduler_name,
                 gang_broker=gang_broker,
                 kill_mode="exit",  # shard.kill hard-exits the process
+                autoscale=shard_autoscale,
             )
             self.elector = None
             self.cache = self.federation.cache
@@ -238,6 +240,56 @@ def main(argv=None) -> int:
         "absorbed by survivors within one TTL",
     )
     parser.add_argument(
+        "--shard-autoscale", choices=("on", "off"), default="off",
+        help="SLO-driven shard autoscaling: the member holding shard "
+        "0's lease grows/shrinks the map's shard count one step at a "
+        "time from sustained fleet p99 / queue-depth signals "
+        "(hysteresis + cooldown); every member then ADOPTS the map's "
+        "count instead of refusing a mismatch.  The controller moves "
+        "the target only — the deploy layer (or loadgen --ramp) scales "
+        "the member fleet to follow it",
+    )
+    parser.add_argument(
+        "--autoscale-min", type=int, default=1,
+        help="shard-count floor the autoscaler never shrinks below",
+    )
+    parser.add_argument(
+        "--autoscale-max", type=int, default=8,
+        help="shard-count ceiling the autoscaler never grows past",
+    )
+    parser.add_argument(
+        "--autoscale-up-p99-ms", type=float, default=500.0,
+        help="scale up when the fleet's windowed submit→bind p99 "
+        "sustains above this",
+    )
+    parser.add_argument(
+        "--autoscale-up-pending", type=int, default=64,
+        help="scale up when schedulable-pending tasks per shard "
+        "sustain above this",
+    )
+    parser.add_argument(
+        "--autoscale-down-p99-ms", type=float, default=50.0,
+        help="scale down only when p99 sustains below this (AND the "
+        "pending bar) — the hysteresis gap against flapping",
+    )
+    parser.add_argument(
+        "--autoscale-down-pending", type=int, default=8,
+        help="scale down only when pending per shard sustains below "
+        "this (AND the p99 bar)",
+    )
+    parser.add_argument(
+        "--autoscale-sustain", type=int, default=3,
+        help="consecutive breaching evaluations before a decision",
+    )
+    parser.add_argument(
+        "--autoscale-cooldown-s", type=float, default=30.0,
+        help="minimum seconds between committed shard-count changes",
+    )
+    parser.add_argument(
+        "--autoscale-period-s", type=float, default=2.0,
+        help="evaluation cadence of the autoscale controller",
+    )
+    parser.add_argument(
         "--gang-broker", choices=("on", "off"), default="on",
         help="cross-shard gang assembly: a home-owned gang below "
         "minMember solicits foreign capacity and commits a full-gang "
@@ -307,6 +359,23 @@ def main(argv=None) -> int:
 
             warmup_kernels()  # times and logs itself
 
+    def _autoscale_policy(a):
+        if a.shard_autoscale != "on":
+            return None
+        from volcano_tpu.federation.autoscale import AutoscalePolicy
+
+        return AutoscalePolicy(
+            min_shards=a.autoscale_min,
+            max_shards=a.autoscale_max,
+            up_p99_ms=a.autoscale_up_p99_ms,
+            up_pending=a.autoscale_up_pending,
+            down_p99_ms=a.autoscale_down_p99_ms,
+            down_pending=a.autoscale_down_pending,
+            sustain=a.autoscale_sustain,
+            cooldown_s=a.autoscale_cooldown_s,
+            eval_period_s=a.autoscale_period_s,
+        )
+
     return serve_forever(
         SchedulerDaemon(
             resolve_bus(args.bus),
@@ -323,6 +392,7 @@ def main(argv=None) -> int:
             shard_identity=args.shard_identity,
             shard_lease_duration=args.shard_lease_duration,
             gang_broker=args.gang_broker == "on",
+            shard_autoscale=_autoscale_policy(args),
             listen_host=args.listen_host,
             listen_port=args.listen_port,
             leader_elect=args.leader_elect,

@@ -97,6 +97,18 @@ DEFAULT_VALUES: Dict[str, Any] = {
         # scheduler (with `replicas: 2` leader-elected standby HA).
         "shards": 0,
         "shard_lease_duration": 2.0,
+        # SLO-driven shard autoscaling (adds --shard-autoscale on to
+        # every member; needs shards > 1 so a standby member exists to
+        # absorb grown slices): the member holding shard 0's lease
+        # moves the map's shard count one step at a time from
+        # sustained fleet p99 / queue-depth signals with hysteresis +
+        # cooldown, and every member ADOPTS the map's count instead of
+        # refusing a mismatch.  The controller moves the TARGET only —
+        # size the member pool (scheduler.shards here, or a cluster
+        # autoscaler on the Deployment set) to the ceiling you want
+        # reachable.  Off by default: the rendered fleet is static
+        # unless an operator opts in.
+        "shard_autoscale": False,
     },
     "controllers": {
         "port": 8081,
@@ -451,6 +463,10 @@ def render(values: Dict[str, Any]) -> List[Tuple[str, Dict[str, Any]]]:
 
     if shards > 1:
         lease = values["scheduler"].get("shard_lease_duration", 2.0)
+        autoscale_args = (
+            ["--shard-autoscale", "on"]
+            if values["scheduler"].get("shard_autoscale") else []
+        )
         for i in range(shards):
             manifests.append(scheduler_manifest(
                 f"30-scheduler-{i}-deployment.yaml",
@@ -459,6 +475,7 @@ def render(values: Dict[str, Any]) -> List[Tuple[str, Dict[str, Any]]]:
                     "--shards", str(shards),
                     "--shard-identity", f"{name}-scheduler-{i}",
                     "--shard-lease-duration", str(lease),
+                    *autoscale_args,
                 ],
                 leader_elect=False,
             ))

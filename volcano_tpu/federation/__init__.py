@@ -27,6 +27,12 @@ Pieces:
   (sketch-gated, O(shards)) and commits a full-gang placement via one
   atomic VBUS v6 ``txn_commit``; conflicts discard the assembly WHOLE
   and retry with bounded backoff, so a partial gang can never exist.
+* :mod:`autoscale` — ``ShardAutoscaler``: SLO-driven shard-count
+  control — the member holding shard 0's lease windows the fleet's
+  submit→bind p99 and pending depth (both piggybacked on the lease
+  heartbeats) and CASes a one-step target change into the map, with
+  hysteresis, sustain, and cooldown; members adopt the new count
+  through the lease manager's elastic mode.
 * :mod:`runtime` — ``FederatedScheduler``: one federation member
   (cache + filter + leases + spillover + broker + scheduler), the unit
   ``vtpu-scheduler --shards N`` runs and the tests/loadgen harnesses
@@ -50,6 +56,10 @@ from volcano_tpu.federation.leases import (  # noqa: F401
 from volcano_tpu.federation.broker import (  # noqa: F401
     GangBroker,
     solicitable_shards,
+)
+from volcano_tpu.federation.autoscale import (  # noqa: F401
+    AutoscalePolicy,
+    ShardAutoscaler,
 )
 from volcano_tpu.federation.runtime import FederatedScheduler  # noqa: F401
 from volcano_tpu.federation.verify import verify_federation  # noqa: F401

@@ -101,12 +101,22 @@ class ShardLeaseManager:
         on_acquire: Optional[Callable[[int], None]] = None,
         on_release: Optional[Callable[[int], None]] = None,
         stats: Optional[Callable[[], dict]] = None,
+        elastic: bool = False,
+        on_resize: Optional[Callable[[int], None]] = None,
     ):
         if n_shards < 1:
             raise ValueError(f"n_shards must be >= 1, got {n_shards}")
         self.api = api
         self.identity = identity
         self.n_shards = n_shards
+        #: elastic mode (the shard autoscaler): the MAP's nShards is
+        #: authoritative and --shards is only the bootstrap value — a
+        #: count mismatch is adopted (release everything, resize via
+        #: on_resize, re-enter the claim loop under the new count)
+        #: instead of refused.  Off = the PR 9 semantics: a mismatched
+        #: member refuses to participate, pinned by tests.
+        self.elastic = elastic
+        self.on_resize = on_resize
         self.namespace = namespace
         self.lease_duration = lease_duration
         self.retry_period = retry_period
@@ -187,9 +197,26 @@ class ShardLeaseManager:
         # exactly the leader.py rationale (monotonic epochs are local)
         attempt_started = time.monotonic()
         cm, rec = self._read()
-        if int(rec.get("nShards", self.n_shards)) != self.n_shards:
-            # a federation must agree on its shard count — refusing to
-            # touch the map beats silently running a different partition
+        map_n = int(rec.get("nShards", self.n_shards))
+        if map_n != self.n_shards:
+            if self.elastic and map_n >= 1:
+                # the autoscaler moved the target: adopt it.  Release
+                # EVERYTHING first (the callbacks see a clean shutdown
+                # of the old partition), resize the runtime's view,
+                # then re-enter the claim loop next tick — absorb deals
+                # us back in under the new count within a lease TTL.
+                log.warning(
+                    "shard map resized %d -> %d; %s re-keying its slice",
+                    self.n_shards, map_n, self.identity,
+                )
+                self._apply(set())
+                if self.on_resize is not None:
+                    self.on_resize(map_n)
+                self.n_shards = map_n
+                return
+            # a static federation must agree on its shard count —
+            # refusing to touch the map beats silently running a
+            # different partition
             log.error(
                 "shard map declares nShards=%s but this scheduler runs "
                 "--shards %d; refusing to participate",

@@ -66,6 +66,7 @@ class FederatedScheduler:
         gang_broker: bool = True,
         gang_assemble_after: int = 2,
         kill_mode: str = "crash",
+        autoscale=None,
     ):
         self.api = api
         self.identity = identity
@@ -92,6 +93,26 @@ class FederatedScheduler:
             assemble_after=gang_assemble_after,
             kill_hook=self._hard_kill,
         ) if gang_broker else None
+        #: SLO-driven shard autoscaling (federation/autoscale.py):
+        #: ``autoscale`` is an AutoscalePolicy (or True for defaults).
+        #: Every member runs the controller object; only the one
+        #: holding shard 0's lease evaluates — and every member's
+        #: lease manager runs ELASTIC (adopts the map's count) so the
+        #: controller's decisions actually move the fleet.
+        self.autoscaler = None
+        if autoscale:
+            from volcano_tpu.federation.autoscale import (
+                AutoscalePolicy,
+                ShardAutoscaler,
+            )
+
+            policy = (
+                autoscale if isinstance(autoscale, AutoscalePolicy)
+                else AutoscalePolicy()
+            )
+            self.autoscaler = ShardAutoscaler(
+                api, self.state, identity, policy=policy,
+            )
         self.leases = ShardLeaseManager(
             api, identity, n_shards,
             lease_duration=lease_duration,
@@ -99,6 +120,8 @@ class FederatedScheduler:
             on_acquire=self._on_acquire,
             on_release=self._on_release,
             stats=self._stats,
+            elastic=self.autoscaler is not None,
+            on_resize=self._on_resize,
         )
         self.scheduler = Scheduler(
             self.cache,
@@ -110,6 +133,12 @@ class FederatedScheduler:
         self.scheduler.post_cycle = self._post_cycle
         self._owned_event = threading.Event()
         self._crashed = False
+        #: schedulable-pending depth from the last post-cycle view —
+        #: the autoscaler's queue-depth signal, published on the lease
+        #: heartbeat.  Written on the scheduler thread, read on the
+        #: lease-manager thread: a plain int (GIL-atomic), staleness of
+        #: one cycle is exactly what a load signal tolerates.
+        self._last_pending = 0
         #: this member's /metrics address, published on the lease-map
         #: stats blob so `vtctl top` discovers the whole federation's
         #: scrape targets from the shard map alone (set by the daemon
@@ -131,6 +160,14 @@ class FederatedScheduler:
         if not self.state.owned():
             self._owned_event.clear()
 
+    def _on_resize(self, n_shards: int) -> None:
+        """Elastic re-key (lease-manager thread): the autoscaler moved
+        the map's shard count.  Every applied shard was already
+        released through the callbacks above; adopt the new partition
+        and let the claim loop deal us back in."""
+        self.state.set_n_shards(n_shards)
+        self._owned_event.clear()
+
     def _stats(self) -> dict:
         # piggybacks on the renew tick: retry any failed relist, then
         # publish this member's observability blob into the map object.
@@ -148,6 +185,17 @@ class FederatedScheduler:
             out["metricsAddr"] = self.metrics_addr
         if self.broker is not None:
             out["gangAssembly"] = self.broker.counters()
+        if self.autoscaler is not None:
+            # the autoscaler's two load signals ride the heartbeat the
+            # members already pay for: schedulable-pending depth and
+            # the cumulative submit→bind buckets the controller windows
+            from volcano_tpu.federation.autoscale import latency_snapshot
+
+            out["pendingTasks"] = self._last_pending
+            lat = latency_snapshot()
+            if lat is not None:
+                out["latency"] = lat
+            out["autoscale"] = self.autoscaler.counters()
         return out
 
     # ---- scheduler hook ----
@@ -160,14 +208,25 @@ class FederatedScheduler:
             log.error("shard.kill fired: %s going down hard", self.identity)
             self._hard_kill()
             return
-        # one O(jobs) pending scan shared by both passes — their
-        # eligibility sets are disjoint (spillover: satisfied/solo
-        # gangs only; broker: below-minMember gangs only), and the
-        # broker re-verifies every claim against store truth anyway
+        # one O(jobs) pending scan shared by all three consumers —
+        # spillover and broker eligibility sets are disjoint
+        # (spillover: satisfied/solo gangs only; broker: below-
+        # minMember gangs only; the broker re-verifies every claim
+        # against store truth anyway), and the autoscaler only counts
         view = (
             self.cache.pending_spill_view()
-            if self.state.n_shards > 1 else []
+            if self.state.n_shards > 1 or self.autoscaler is not None
+            else []
         )
+        if self.autoscaler is not None:
+            from volcano_tpu.federation.autoscale import owned_pending
+
+            # scoped to OWNED home shards: per-member reports must
+            # partition the fleet backlog, not multiply it (at one
+            # shard every member's raw view IS the whole backlog)
+            self._last_pending = owned_pending(
+                view, self.state.owned(), self.state.n_shards
+            )
         self.spillover.run_once(view)
         if self.broker is not None and not self._crashed:
             self.broker.run_once(view)
@@ -191,6 +250,8 @@ class FederatedScheduler:
         ``run_once`` by hand)."""
         self.cache.run()
         self.leases.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         return self
 
     def wait_owned(self, timeout: float = 10.0) -> bool:
@@ -203,6 +264,8 @@ class FederatedScheduler:
     def stop(self) -> None:
         """Graceful: release shards so peers take over immediately."""
         self.scheduler.stop()
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         self.leases.stop(release=True)
         self.cache.stop_commit_plane()
 
@@ -212,5 +275,7 @@ class FederatedScheduler:
         path the chaos tests exercise."""
         self._crashed = True
         self.scheduler.stop()
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         self.leases.stop(release=False)
         self.cache.stop_commit_plane()

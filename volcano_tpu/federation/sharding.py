@@ -66,6 +66,18 @@ class ShardState:
         self._lock = threading.Lock()
         self._owned: Set[int] = set()  # guarded-by: self._lock
 
+    def set_n_shards(self, n_shards: int) -> None:
+        """Adopt a new shard count (the autoscaler's elastic re-key).
+        Ownership clears with it: the caller has already released every
+        applied shard through the lease callbacks, and slices under the
+        new count must be re-claimed through the lease plane — never
+        carried over from a partition that no longer exists."""
+        if n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        with self._lock:
+            self.n_shards = n_shards
+            self._owned.clear()
+
     def owned(self) -> Set[int]:
         with self._lock:
             return set(self._owned)

@@ -146,6 +146,26 @@ class _Registry:
                 lines.append(f"{name}{fmt_labels(labels)} {v}")
         return "\n".join(lines) + "\n"
 
+    def histogram_snapshot(self, name: str, labels=None):
+        """Cumulative scrape-shaped snapshot of one histogram —
+        ``{"buckets": [(le, cumulative)...], "sum", "count"}``, the
+        exact shape ``metrics/scrape.py`` parses from /metrics text, so
+        the shard autoscaler's windowed quantiles reuse
+        ``merge_histograms``/``histogram_quantile`` unchanged.  None
+        when the series was never observed."""
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                return None
+            cumulative = 0
+            buckets = []
+            for bound, c in zip(h.buckets, h.counts):
+                cumulative += c
+                buckets.append((str(bound), float(cumulative)))
+            buckets.append(("+Inf", float(h.total)))
+            return {"buckets": buckets, "sum": h.sum, "count": float(h.total)}
+
     def reset(self) -> None:
         with self._lock:
             self._histograms.clear()
@@ -166,6 +186,7 @@ registry = _Registry()
 _IDENTITY_ROLES = (
     "scheduler", "controllers", "admission", "apiserver",
     "compute-plane", "leader", "follower", "standalone", "init",
+    "removed",
 )
 
 
@@ -458,8 +479,29 @@ def update_repl_lag(entries: int) -> None:
     registry.set_gauge(f"{_NAMESPACE}_repl_lag_entries", {}, entries)
 
 
-#: bounded role vocabulary for the one-hot role gauge
-_REPL_ROLES = ("leader", "follower", "standalone", "init")
+def update_membership_epoch(epoch: int) -> None:
+    """volcano_repl_membership_epoch: the replication group's
+    membership-config version (bumped by every committed add/remove) —
+    a divergence between replicas' exported values is a config change
+    still propagating; a persistent divergence is the split the
+    membership chaos drill exists to rule out."""
+    registry.set_gauge(f"{_NAMESPACE}_repl_membership_epoch", {}, epoch)
+
+
+def register_autoscale_decision(direction: str) -> None:
+    """volcano_shard_autoscale_decisions_total{direction}: one count
+    per shard-count change the autoscale controller committed to the
+    shard map."""
+    # label-vocab: direction ∈ {up, down}
+    registry.inc(
+        f"{_NAMESPACE}_shard_autoscale_decisions_total",
+        {"direction": direction},
+    )
+
+
+#: bounded role vocabulary for the one-hot role gauge ("removed" is a
+#: replica retired by a membership change, still alive for reads)
+_REPL_ROLES = ("leader", "follower", "standalone", "init", "removed")
 
 
 def update_repl_role(role: str) -> None:
