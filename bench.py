@@ -124,6 +124,38 @@ def _relay_components(in_bytes: int, out_elems: int, iters: int = 5):
     return f, b, max(o - b, 0.0), max(f - o, 0.0)
 
 
+def _serde_legs(n_objs: int, iters: int = 5, codec: "str | None" = None):
+    """The serialization share of the relay floor, reported as its own
+    pair of legs (``encode_ms`` / ``decode_ms``) so codec wins are
+    visible separately from the link (``bus_rtt_ms``): median time to
+    encode and decode a commit_batch-shaped body carrying ``n_objs``
+    bind writes under the codec a v8 connection would negotiate
+    (binary when msgpack is importable, JSON otherwise).  Returns
+    ``(encode_s, decode_s)``."""
+    from volcano_tpu.bus import protocol
+
+    if codec is None:
+        codec = (protocol.CODEC_BINARY if protocol.HAS_BINARY
+                 else protocol.CODEC_JSON)
+    body = {
+        "op": "commit_batch",
+        "binds": [
+            {"kind": "Pod", "namespace": "default", "name": f"pod-{i}",
+             "hostname": f"node-{i % 64}", "rv": i}
+            for i in range(max(n_objs, 1))
+        ],
+    }
+    es, ds = [], []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        wire = protocol.encode_payload(body, codec=codec)
+        es.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        protocol.decode_payload(wire, codec=codec)
+        ds.append(time.perf_counter() - t0)
+    return float(np.median(es)), float(np.median(ds))
+
+
 def _pipelined_cycle_s(dispatch, k: int = 8, iters: int = 3) -> "float | None":
     """Steady-state per-cycle session latency with the PIPELINED commit
     plane: cycle N's result is drained (the bind workers' device→host
@@ -301,10 +333,12 @@ def bench_config(name: str, kwargs: dict, iters: int = 5) -> dict:
     # pipeline collapses.  Native sessions never touch the device.
     if executor == "native":
         rtt_s = bind_leg_s = writeback_leg_s = 0.0
+        encode_leg_s = decode_leg_s = 0.0
     else:
         _full, rtt_s, bind_leg_s, writeback_leg_s = _relay_components(
             in_bytes, snap.n_tasks
         )
+        encode_leg_s, decode_leg_s = _serde_legs(snap.n_tasks)
 
     # Native baseline — best of 1-thread and 16-thread (the pooled sweep
     # only wins on some shapes; the reference would use whichever is
@@ -357,6 +391,8 @@ def bench_config(name: str, kwargs: dict, iters: int = 5) -> dict:
         "bus_rtt_ms": round(rtt_s * 1e3, 3),
         "bind_ms": round(bind_leg_s * 1e3, 3),
         "writeback_ms": round(writeback_leg_s * 1e3, 3),
+        "encode_ms": round(encode_leg_s * 1e3, 3),
+        "decode_ms": round(decode_leg_s * 1e3, 3),
         "pipelined": pipelined_s is not None,
         "vs_baseline_compute": round(baseline_s / compute_s, 2)
         if baseline_s == baseline_s and compute_s
@@ -421,8 +457,10 @@ def bench_preempt_config(name: str, kwargs: dict, iters: int = 5) -> dict:
         _full, rtt_s, bind_leg_s, writeback_leg_s = _relay_components(
             in_bytes, pk.base.n_tasks
         )
+        encode_leg_s, decode_leg_s = _serde_legs(pk.base.n_tasks)
     else:
         rtt_s = bind_leg_s = writeback_leg_s = 0.0
+        encode_leg_s = decode_leg_s = 0.0
 
     base_iters = 1
     try:
@@ -466,6 +504,8 @@ def bench_preempt_config(name: str, kwargs: dict, iters: int = 5) -> dict:
         "bus_rtt_ms": round(rtt_s * 1e3, 3),
         "bind_ms": round(bind_leg_s * 1e3, 3),
         "writeback_ms": round(writeback_leg_s * 1e3, 3),
+        "encode_ms": round(encode_leg_s * 1e3, 3),
+        "decode_ms": round(decode_leg_s * 1e3, 3),
         "pipelined": pipelined_s is not None,
         "vs_baseline_compute": round(baseline_s / compute_s, 2)
         if baseline_s == baseline_s and compute_s

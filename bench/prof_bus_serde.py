@@ -9,15 +9,22 @@ entry body is serialized once (``bus/server.py::_CachedPayload``) and
 the cached bytes are shared by every per-connection writer and spliced
 into ``watch_batch`` frames.
 
-This profile counts both sides of the cache — ``raw()`` *calls* (the
-per-subscriber fan-out) vs actual *encodes* — while M real TCP
-subscribers drain K store mutations, and fails when encodes stop being
-O(events).
+This profile counts both sides of the cache — ``raw()``/``raw_bin()``
+*calls* (the per-subscriber fan-out, whichever codec the connections
+negotiated) vs actual *encodes* — while M real TCP subscribers drain
+K store mutations, and fails when encodes stop being O(events).
+
+Since VBUS v8 it also emits the codec-floor comparison the CI
+``serde-floor`` artifact pins: encode + decode ns/frame and
+bytes/frame for a watch-event body of every registered kind, JSON vs
+binary (msgpack), so a codec regression shows up as a number, not a
+feeling.
 
 Usage::
 
     JAX_PLATFORMS=cpu python bench/prof_bus_serde.py
     python bench/prof_bus_serde.py --subscribers 8 --events 2000
+    python bench/prof_bus_serde.py --codecs-only   # just the comparison
 """
 
 from __future__ import annotations
@@ -34,7 +41,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 def run(subscribers: int, events: int, timeout: float) -> dict:
     from volcano_tpu.apis import core
-    from volcano_tpu.bus import protocol
     from volcano_tpu.bus import server as server_mod
     from volcano_tpu.bus.remote import RemoteAPIServer
     from volcano_tpu.bus.server import BusServer
@@ -43,7 +49,10 @@ def run(subscribers: int, events: int, timeout: float) -> dict:
     counts = {"fanout_calls": 0, "encodes": 0}
     lock = threading.Lock()
     original_raw = server_mod._CachedPayload.raw
+    original_raw_bin = server_mod._CachedPayload.raw_bin
 
+    # count BOTH cache slots: v8 connections negotiate binary and fan
+    # out through raw_bin(); a JSON-pinned run still rides raw()
     def counting_raw(self):
         with lock:
             counts["fanout_calls"] += 1
@@ -51,7 +60,15 @@ def run(subscribers: int, events: int, timeout: float) -> dict:
                 counts["encodes"] += 1
         return original_raw(self)
 
+    def counting_raw_bin(self):
+        with lock:
+            counts["fanout_calls"] += 1
+            if self._raw_bin is None:
+                counts["encodes"] += 1
+        return original_raw_bin(self)
+
     server_mod._CachedPayload.raw = counting_raw
+    server_mod._CachedPayload.raw_bin = counting_raw_bin
     api = APIServer()
     bus = BusServer(api).start()
     clients = []
@@ -87,6 +104,7 @@ def run(subscribers: int, events: int, timeout: float) -> dict:
         elapsed = time.perf_counter() - start
     finally:
         server_mod._CachedPayload.raw = original_raw
+        server_mod._CachedPayload.raw_bin = original_raw_bin
         for c in clients:
             c.close()
         bus.stop()
@@ -111,13 +129,79 @@ def run(subscribers: int, events: int, timeout: float) -> dict:
     }
 
 
+def _exemplar_corpus() -> dict:
+    """kind → encoded exemplar dict.  The canonical corpus lives in
+    ``tests/test_bus.py::SERDE_EXEMPLARS`` (the SRD001/SRD006 fixture);
+    outside a repo checkout fall back to a representative Pod so the
+    profile still runs against an installed package."""
+    from volcano_tpu.bus import protocol
+
+    try:
+        from tests.test_bus import SERDE_EXEMPLARS
+        return {
+            kind: protocol.encode_obj(make())
+            for kind, make in sorted(SERDE_EXEMPLARS.items())
+        }
+    except ImportError:
+        from volcano_tpu.apis import core
+
+        pod = core.Pod(
+            metadata=core.ObjectMeta(name="p0", namespace="ns"),
+            spec=core.PodSpec(),
+            status=core.PodStatus(phase="Pending"),
+        )
+        return {"Pod": protocol.encode_obj(pod)}
+
+
+def codec_compare(iters: int = 300) -> list:
+    """The serde floor per kind per codec: median-free simple mean of
+    ``iters`` encode and decode passes over a watch-event body (the
+    fan-out hot path's frame shape), plus the wire size.  One row per
+    (kind, codec)."""
+    from volcano_tpu.bus import protocol
+
+    codecs = [protocol.CODEC_JSON]
+    if protocol.HAS_BINARY:
+        codecs.append(protocol.CODEC_BINARY)
+    rows = []
+    for kind, data in _exemplar_corpus().items():
+        body = {"watch_id": 7, "seq": 1, "kind": kind, "event": "ADDED",
+                "old": None, "new": data, "ts": 0.0}
+        for codec in codecs:
+            wire = protocol.encode_payload(body, codec=codec)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                protocol.encode_payload(body, codec=codec)
+            enc_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                protocol.decode_payload(wire, codec=codec)
+            dec_s = time.perf_counter() - t0
+            rows.append({
+                "kind": kind,
+                "codec": codec,
+                "bytes_per_frame": len(wire),
+                "encode_ns_per_frame": round(enc_s / iters * 1e9),
+                "decode_ns_per_frame": round(dec_s / iters * 1e9),
+            })
+    return rows
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="prof_bus_serde")
     p.add_argument("--subscribers", type=int, default=4)
     p.add_argument("--events", type=int, default=1000)
     p.add_argument("--timeout", type=float, default=60.0)
+    p.add_argument("--codec-iters", type=int, default=300)
+    p.add_argument("--codecs-only", action="store_true",
+                   help="emit only the JSON-vs-binary serde floor "
+                   "(no live bus fan-out run)")
     args = p.parse_args(argv)
-    report = run(args.subscribers, args.events, args.timeout)
+    if args.codecs_only:
+        report = {"harness": "prof_bus_serde", "ok": True}
+    else:
+        report = run(args.subscribers, args.events, args.timeout)
+    report["codec_compare"] = codec_compare(args.codec_iters)
     json.dump(report, sys.stdout, indent=2)
     sys.stdout.write("\n")
     if not report["ok"]:

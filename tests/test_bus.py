@@ -696,6 +696,26 @@ class TestSerdeRoundTrip:
                            separators=(",", ":")).encode()
         assert protocol.decode_obj(_json.loads(wire.decode())) == obj
 
+    @pytest.mark.parametrize("kind", sorted(protocol.KINDS))
+    def test_round_trip_through_binary_wire_bytes(self, kind):
+        """The v8 frame path: dict → msgpack bytes → dict → object.
+        Every kind in SERDE_EXEMPLARS must survive CODEC_BINARY exactly
+        as it survives JSON — this is the test SRD006 requires, and it
+        would catch a kind whose encoded form only JSON can carry."""
+        if not protocol.HAS_BINARY:
+            pytest.skip("msgpack unavailable — binary framing disabled")
+        obj = SERDE_EXEMPLARS[kind]()
+        wire = protocol.encode_payload(
+            protocol.encode_obj(obj), codec=protocol.CODEC_BINARY
+        )
+        back = protocol.decode_payload(wire, codec=protocol.CODEC_BINARY)
+        assert protocol.decode_obj(back) == obj
+        # both framings must decode to the SAME dict — byte-level
+        # conformance of the payload contents across codecs
+        assert back == protocol.decode_payload(
+            protocol.encode_payload(protocol.encode_obj(obj)),
+        )
+
 
 class TestWatchBatch:
     """Protocol v3 coalesced watch delivery: the writer thread batches
@@ -967,6 +987,7 @@ class TestSerdeOncePerEvent:
 
         counts = {"encodes": 0, "calls": 0}
         original_raw = server_mod._CachedPayload.raw
+        original_raw_bin = server_mod._CachedPayload.raw_bin
 
         def counting_raw(self):
             counts["calls"] += 1
@@ -974,7 +995,17 @@ class TestSerdeOncePerEvent:
                 counts["encodes"] += 1
             return original_raw(self)
 
+        def counting_raw_bin(self):
+            # v8 connections cache binary bodies instead — the
+            # once-per-event invariant covers BOTH codecs
+            counts["calls"] += 1
+            if self._raw_bin is None:
+                counts["encodes"] += 1
+            return original_raw_bin(self)
+
         monkeypatch.setattr(server_mod._CachedPayload, "raw", counting_raw)
+        monkeypatch.setattr(server_mod._CachedPayload, "raw_bin",
+                            counting_raw_bin)
         api = APIServer()
         srv = BusServer(api, bookmark_interval=3600).start()
         clients, seen = [], []

@@ -162,9 +162,9 @@ class TestWalRecovery:
         api.close()
         payloads, _, _ = read_records(os.path.join(d, "wal.log"))
         assert len(payloads) == 3  # 2 creates + 1 batch
-        import json
+        from volcano_tpu.bus import protocol
 
-        batch = json.loads(payloads[-1])
+        batch = protocol.decode_record(payloads[-1])
         assert len(batch["events"]) == 2  # both binds in one record
 
     def test_snapshot_rotation_and_recovery(self, tmp_path):

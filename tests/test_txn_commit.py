@@ -21,7 +21,6 @@ store lock hold.  Pins:
   replica holds the gang whole or not at all.
 """
 
-import json
 import socket
 import time
 
@@ -29,6 +28,7 @@ import pytest
 
 from volcano_tpu import faults
 from volcano_tpu.apis import core
+from volcano_tpu.bus import protocol
 from volcano_tpu.bus.remote import RemoteAPIServer
 from volcano_tpu.bus.replication import ReplicaManager
 from volcano_tpu.bus.server import BusServer
@@ -271,7 +271,7 @@ class TestTxnCommitDurability:
             assert len(records) == before + 1, (
                 "the gang must be ONE atomic record, not one per bind"
             )
-            last = json.loads(records[-1].decode())
+            last = protocol.decode_record(records[-1])
             assert len(last["events"]) == 3
             assert all(e[1] == "MODIFIED" for e in last["events"])
         finally:
@@ -386,7 +386,7 @@ class TestTxnCommitReplication:
                 wal = str(tmp_path / f"r{i}" / WAL_FILE)
                 gang_records = [
                     rec for rec in (
-                        json.loads(p.decode())
+                        protocol.decode_record(p)
                         for p in read_records(wal)[0]
                     )
                     if any(

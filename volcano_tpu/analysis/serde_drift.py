@@ -1,7 +1,7 @@
 """VBUS serde/version-drift pass — the v1-stamping rule PR 6's review
 caught by hand, made machine-checked.
 
-Four invariants over the bus protocol surface:
+Six invariants over the bus protocol surface:
 
 * ``SRD001`` — every object kind registered in
   ``bus/protocol.py::KINDS`` has a serde round-trip exemplar in
@@ -26,6 +26,12 @@ Four invariants over the bus protocol surface:
   "version 3" three versions late — by hand; this makes the doc-drift
   machine-checked.  Judged only when README.md exists (a repo
   checkout), like SRD001.
+* ``SRD006`` — the exemplar corpus must round-trip through BOTH wire
+  codecs: some test in ``tests/test_bus.py`` must drive
+  ``SERDE_EXEMPLARS`` through the binary (``CODEC_BINARY``) framing,
+  not just JSON.  A kind whose encoded form survives JSON but not
+  msgpack (bytes values, non-string map keys) would otherwise ship
+  undetected the day a binary peer connects.
 
 This pass imports ``volcano_tpu.bus.protocol`` (our own package — the
 registries are the source of truth) and parses ``server.py`` /
@@ -46,6 +52,7 @@ CODE_UNREGISTERED_OP = "SRD002"
 CODE_UNGATED_OP = "SRD003"
 CODE_OP_DRIFT = "SRD004"
 CODE_DOC_DRIFT = "SRD005"
+CODE_NO_BINARY_ROUNDTRIP = "SRD006"
 
 _PROTO = "volcano_tpu/bus/protocol.py"
 _SERVER = "volcano_tpu/bus/server.py"
@@ -106,6 +113,21 @@ def _client_ops(src: SourceFile) -> dict:
     return ops
 
 
+def _has_binary_roundtrip(src: SourceFile) -> bool:
+    """True when some test function drives the ``SERDE_EXEMPLARS``
+    corpus through the binary framing — textually, its source
+    references both the corpus and ``CODEC_BINARY``."""
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not node.name.startswith("test"):
+            continue
+        fn_src = ast.get_source_segment(src.text, node) or ""
+        if "SERDE_EXEMPLARS" in fn_src and "CODEC_BINARY" in fn_src:
+            return True
+    return False
+
+
 def _exemplar_kinds(src: SourceFile) -> Optional[Set[str]]:
     """Keys of the module-level ``SERDE_EXEMPLARS`` mapping, or None
     when the mapping does not exist at all."""
@@ -152,6 +174,17 @@ def run(root: str) -> List[Finding]:
                     f"KINDS but has no serde round-trip exemplar in "
                     f"{_TESTS}::SERDE_EXEMPLARS",
                 ))
+        # SRD006: the same corpus must survive the binary framing too
+        if exemplars is not None and not _has_binary_roundtrip(tests):
+            findings.append(Finding(
+                PASS, CODE_NO_BINARY_ROUNDTRIP, _TESTS, 1,
+                "binary-roundtrip",
+                f"{_TESTS} round-trips SERDE_EXEMPLARS through JSON "
+                f"only — no test drives the corpus through the binary "
+                f"framing (protocol.CODEC_BINARY), so a kind whose "
+                f"encoding survives JSON but not msgpack would ship "
+                f"undetected",
+            ))
 
     # ---- op registries ----
     op_versions = getattr(protocol, "OP_VERSIONS", None)

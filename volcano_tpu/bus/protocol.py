@@ -31,6 +31,17 @@ import socket
 import struct
 from typing import Dict, List, Optional, Tuple
 
+try:  # optional: binary framing needs msgpack; JSON framing never does
+    import msgpack  # type: ignore[import-not-found]
+except ImportError:  # pragma: no cover - environment without msgpack
+    msgpack = None
+
+#: whether this build can speak the v8 binary codec at all.  When
+#: msgpack is absent the hello negotiation simply never offers binary,
+#: so every connection (and the WAL) stays JSON — no feature flag, no
+#: error path.
+HAS_BINARY = msgpack is not None
+
 from volcano_tpu.apis import batch, core, scheduling, scheme
 from volcano_tpu.apis import bus as apis_bus
 from volcano_tpu.client.apiserver import (
@@ -85,13 +96,31 @@ MAGIC = b"VBUS"
 #: typed "dynamic membership unsupported" error (no fallback CAN exist
 #: — an old peer has no config log to record the change in), and a
 #: pre-vote that cannot be asked counts as a denial (safety over
-#: liveness; an old peer cannot be a v7 replica anyway).
+#: liveness; an old peer cannot be a v7 replica anyway).  v8 adds the
+#: binary codec: ``bus_hello`` negotiates a per-connection body
+#: encoding (msgpack) and is the FIRST version to change what a frame
+#: carries — so v8 is also the first version a frame is ever stamped
+#: with.  The stamp is per frame, not per connection: JSON bodies ride
+#: frames stamped MIN_VERSION exactly as before (a v1 peer accepts
+#: them), msgpack bodies ride frames stamped 8, and the receiver
+#: decodes by the stamp alone.  That makes the hello race-free — the
+#: hello response is decodable whichever codec it arrives in — and
+#: keeps the v1-fallback discipline intact: binary frames are sent
+#: ONLY after the peer answered the hello with ``binary``, and a
+#: pre-v8 peer answers ``unknown bus op`` to the hello itself, which
+#: degrades the connection to JSON (never an error).
 #: VERSION is the protocol revision this build speaks; receivers
 #: accept [MIN_VERSION, VERSION].
-VERSION = 7
+VERSION = 8
 #: oldest frame version this build still decodes — and the version
-#: outgoing frames carry, since the layout has not changed since v1
+#: JSON-body frames carry, since their layout has not changed since v1.
+#: Binary-body frames are stamped VERSION: the body encoding IS the
+#: layout change, and the stamp is how the receiver tells them apart.
 MIN_VERSION = 1
+
+#: per-connection body codecs the hello exchange negotiates
+CODEC_JSON = "json"
+CODEC_BINARY = "binary"
 
 T_REQ = 1            # client → server: one store operation
 T_RESP = 2           # server → client: success payload for a T_REQ
@@ -156,6 +185,7 @@ OP_VERSIONS: Dict[str, int] = {
     "repl_prevote": 7,
     "bus_add_replica": 7,
     "bus_remove_replica": 7,
+    "bus_hello": 8,
 }
 
 #: wire error name → exception class; unknown names fall back to ApiError.
@@ -235,11 +265,17 @@ def raise_error(payload: dict) -> None:
 
 def parse_bus_url(url: str) -> Tuple[str, int]:
     """``tcp://host:port`` → (host, port).  A bare ``host:port`` is
-    accepted for convenience."""
+    accepted for convenience.  ``shm://host:port`` parses identically:
+    the address still names the TCP endpoint (the shm ring directory is
+    derived from it, and TCP is the attach-failure fallback), the
+    scheme just asks the client to try the same-host ring first."""
     if url.startswith("tcp://"):
         url = url[len("tcp://"):]
+    elif url.startswith("shm://"):
+        url = url[len("shm://"):]
     elif "://" in url:
-        raise ValueError(f"unsupported bus scheme in {url!r} (use tcp://)")
+        raise ValueError(
+            f"unsupported bus scheme in {url!r} (use tcp:// or shm://)")
     host, sep, port = url.rpartition(":")
     if not sep or not port.isdigit():
         raise ValueError(f"bus address needs host:port, got {url!r}")
@@ -261,25 +297,45 @@ def parse_bus_endpoints(urls: str) -> List[Tuple[str, int]]:
     return out
 
 
-def encode_payload(payload: dict) -> bytes:
+def encode_payload(payload: dict, codec: str = CODEC_JSON) -> bytes:
     """Serialize one frame body.  Split out of :func:`send_frame` so the
     bus server can serialize a watch event ONCE and fan the cached bytes
     out to every subscriber (the correlation id lives in the frame
     header, so the body bytes are subscriber-independent)."""
+    if codec == CODEC_BINARY:
+        return msgpack.packb(payload, use_bin_type=True)
     return json.dumps(payload, separators=(",", ":")).encode()
 
 
+def decode_payload(body: bytes, codec: str = CODEC_JSON) -> dict:
+    """Deserialize one frame body (the inverse of encode_payload)."""
+    if not body:
+        return {}
+    if codec == CODEC_BINARY:
+        if msgpack is None:
+            raise BusError("binary frame received but msgpack is unavailable")
+        return msgpack.unpackb(body, raw=False)
+    return json.loads(body.decode())
+
+
 def send_frame_raw(sock: socket.socket, mtype: int, corr_id: int,
-                   body: bytes) -> None:
-    """Send a frame whose body is already serialized."""
-    # stamped MIN_VERSION: the layout is v1's, so version-skewed peers
-    # never reject at the framing layer — capability skew surfaces as an
-    # op-level typed error instead (the commit_batch fallback path)
-    sock.sendall(_HEADER.pack(MAGIC, MIN_VERSION, mtype, corr_id, len(body)) + body)
+                   body: bytes, codec: str = CODEC_JSON) -> None:
+    """Send a frame whose body is already serialized in ``codec``."""
+    # JSON bodies are stamped MIN_VERSION: their layout is v1's, so
+    # version-skewed peers never reject at the framing layer —
+    # capability skew surfaces as an op-level typed error instead (the
+    # commit_batch fallback path).  Binary bodies are stamped VERSION:
+    # the stamp is the per-frame codec marker the receiver decodes by,
+    # and a pre-v8 peer (which could not decode the body anyway) rejects
+    # at the header — but binary is only ever sent to a peer that asked
+    # for it through the bus_hello negotiation.
+    version = VERSION if codec == CODEC_BINARY else MIN_VERSION
+    sock.sendall(_HEADER.pack(MAGIC, version, mtype, corr_id, len(body)) + body)
 
 
-def send_frame(sock: socket.socket, mtype: int, corr_id: int, payload: dict) -> None:
-    send_frame_raw(sock, mtype, corr_id, encode_payload(payload))
+def send_frame(sock: socket.socket, mtype: int, corr_id: int, payload: dict,
+               codec: str = CODEC_JSON) -> None:
+    send_frame_raw(sock, mtype, corr_id, encode_payload(payload, codec), codec)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -299,5 +355,42 @@ def recv_frame(sock: socket.socket) -> Tuple[int, int, dict]:
         raise ValueError("bad magic")
     if not (MIN_VERSION <= version <= VERSION):
         raise ValueError(f"unsupported bus protocol version {version}")
-    payload = json.loads(_recv_exact(sock, length).decode()) if length else {}
-    return mtype, corr_id, payload
+    body = _recv_exact(sock, length) if length else b""
+    # the codec is read off the frame, not off connection state: a v8
+    # stamp means a msgpack body, anything older is JSON.  This is what
+    # makes the hello exchange race-free — the response decodes
+    # correctly whichever codec the server sent it in.
+    codec = CODEC_BINARY if version >= 8 else CODEC_JSON
+    return mtype, corr_id, decode_payload(body, codec)
+
+
+# ---- WAL record codec ----------------------------------------------------
+#
+# WAL records adopt the SAME body encoding as the wire so replication can
+# ship record bytes verbatim to followers without a decode/re-encode leg.
+# The on-disk codec is sniffed from the first byte on read: a JSON record
+# opens with '{' (0x7b), a msgpack map opens with a fixmap/map16/map32
+# marker — so old JSON logs recover under a binary-default build and
+# vice versa, record by record.
+
+_MSGPACK_MAP_MARKERS = frozenset(
+    list(range(0x80, 0x90)) + [0xDE, 0xDF])
+
+
+def encode_record(record: dict, codec: Optional[str] = None) -> bytes:
+    """Serialize one WAL record.  ``codec=None`` picks the build
+    default: binary when msgpack is importable, JSON otherwise."""
+    if codec is None:
+        codec = CODEC_BINARY if HAS_BINARY else CODEC_JSON
+    return encode_payload(record, codec)
+
+
+def decode_record(payload: bytes) -> dict:
+    """Deserialize one WAL record, sniffing the codec from its first
+    byte (both codecs open a top-level map with a distinct marker)."""
+    if payload[:1] == b"{":
+        return json.loads(payload.decode())
+    if payload and payload[0] in _MSGPACK_MAP_MARKERS:
+        return decode_payload(payload, CODEC_BINARY)
+    raise ValueError(
+        f"unrecognized WAL record codec (first byte {payload[:1]!r})")

@@ -156,6 +156,15 @@ class RemoteAPIServer:
         #: set once a server rejects the v5 ``bus_status`` op — status
         #: queries then answer a degraded ``role: unknown`` payload
         self._no_bus_status = False
+        #: set once a server rejects the v8 ``bus_hello`` op — the
+        #: connection (and every reconnect after it) then stays on JSON
+        #: framing, exactly the pre-v8 wire format
+        self._no_bus_hello = False
+        #: negotiated body codec for the CURRENT connection — reset to
+        #: JSON on every (re)dial, flipped to binary only when the
+        #: server's hello answer says so.  Frames are stamped per frame,
+        #: so a stale value can never misdecode anything.
+        self.codec = protocol.CODEC_JSON
         #: this client must sit on the LEADER (set by
         #: register_admission: webhook reviews are forwarded by the
         #: server that runs the store transaction, which is always the
@@ -194,13 +203,28 @@ class RemoteAPIServer:
         self.address = url
         return protocol.parse_bus_url(url)
 
+    def _dial(self) -> socket.socket:
+        """One transport attempt at the current endpoint: the same-host
+        shm ring first when enabled (``local_up --multiproc``), TCP
+        otherwise — and TCP as the silent fallback whenever the ring
+        attach fails for ANY reason (no listener, no directory, no
+        fd-passing).  Both return socket-shaped objects carrying the
+        identical frame stream."""
+        host, port = self._current_endpoint()
+        from volcano_tpu.bus import shm
+
+        if shm.shm_enabled() and host in ("127.0.0.1", "localhost", "::1"):
+            try:
+                return shm.connect(port, timeout=self.timeout)
+            except (OSError, ValueError, ConnectionError) as e:
+                log.debug("bus shm attach failed (%s); dialing TCP", e)
+        return socket.create_connection((host, port), timeout=self.timeout)
+
     def _conn_loop(self) -> None:
         backoff = self.reconnect_min
         while not self._closed:
             try:
-                sock = socket.create_connection(
-                    self._current_endpoint(), timeout=self.timeout
-                )
+                sock = self._dial()
             except OSError:
                 # rotate to the next replica before backing off — a
                 # dead endpoint must not serialize the whole list
@@ -212,6 +236,7 @@ class RemoteAPIServer:
                 continue
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             backoff = self.reconnect_min
+            self.codec = protocol.CODEC_JSON  # until the hello says otherwise
             self._sock = sock
             reader = threading.Thread(
                 target=self._read_loop, args=(sock,),
@@ -223,6 +248,14 @@ class RemoteAPIServer:
                 log.info("bus %s reconnected", self.address)
             self._ever_connected = True
             self._connected.set()
+            try:
+                self._negotiate_codec()
+            except (ApiError, OSError):
+                # negotiation must never cost the connection: any
+                # failure here leaves the codec on JSON and the session
+                # proceeds (a true transport loss surfaces through the
+                # reader thread's disconnect signal regardless)
+                self.codec = protocol.CODEC_JSON
             if self._must_lead and not self._leader_check():
                 # connected to a follower while this client must sit on
                 # the leader (admission endpoint): redial at the leader
@@ -251,6 +284,43 @@ class RemoteAPIServer:
             self._connected.clear()
             self._teardown_socket(sock)
             self._fail_pending(BusError("bus connection lost"))
+
+    def _negotiate_codec(self) -> None:
+        """VBUS v8 codec negotiation — the FIRST exchange on every
+        fresh connection (before the leader check and the session
+        resync, so both ride the negotiated codec).  The hello itself
+        always goes as a JSON frame; the reply is decoded by its frame
+        stamp, so there is no ordering race with the server's codec
+        flip.  Degrades to JSON — never errors — on ANY non-binary
+        answer: a pre-v8 server answers ``unknown bus op`` (degrade
+        PERMANENTLY per connection lifetime, like every capability
+        flag), a msgpack-less build never offers binary at all, and an
+        explicit ``codec: json`` answer is honored as-is.  Every
+        degradation increments ``volcano_bus_codec_fallbacks_total``."""
+        if self._no_bus_hello or not protocol.HAS_BINARY:
+            return
+        try:
+            resp = self._call({
+                "op": "bus_hello",
+                "codecs": [protocol.CODEC_BINARY, protocol.CODEC_JSON],
+            })
+        except BusError:
+            raise  # transport failure — NOT a capability signal
+        except ApiError as e:
+            if "unknown bus op" not in str(e):
+                raise
+            log.warning(
+                "bus %s does not speak bus_hello (old peer); JSON framing",
+                self.address,
+            )
+            self._no_bus_hello = True
+            metrics.register_bus_codec_fallback()
+            return
+        if resp.get("codec") == protocol.CODEC_BINARY:
+            self.codec = protocol.CODEC_BINARY
+        else:
+            self.codec = protocol.CODEC_JSON
+            metrics.register_bus_codec_fallback()
 
     def _leader_check(self) -> bool:
         """True when the connected peer can host this client (leader,
@@ -444,7 +514,8 @@ class RemoteAPIServer:
             if sock is None:
                 raise BusError("bus connection lost")
             with self._send_lock:
-                protocol.send_frame(sock, mtype, req_id, payload)
+                protocol.send_frame(sock, mtype, req_id, payload,
+                                    codec=self.codec)
         except (OSError, BusError) as e:
             with self._pending_lock:
                 self._pending.pop(req_id, None)
@@ -511,7 +582,8 @@ class RemoteAPIServer:
             return
         try:
             with self._send_lock:
-                protocol.send_frame(sock, mtype, corr_id, payload)
+                protocol.send_frame(sock, mtype, corr_id, payload,
+                                    codec=self.codec)
         except OSError:
             pass
 

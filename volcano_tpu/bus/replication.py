@@ -56,6 +56,7 @@ commit — wired through ``PersistentAPIServer.kill_hook``).
 
 from __future__ import annotations
 
+import base64
 import socket
 import threading
 import time
@@ -76,6 +77,36 @@ _RETAIN = 4096
 
 #: per-pull shipment cap (frames stay bounded like _WATCH_BATCH_MAX)
 _PULL_MAX = 256
+
+
+def _ship_record(r: dict, codec: str) -> dict:
+    """One record of a ``repl_append`` response.  The CRC chain covers
+    the canonical payload BYTES, so the follower must store a
+    byte-identical copy: binary conns carry the raw bytes verbatim
+    (msgpack bin — the zero-copy path); JSON conns carry the exact
+    source string for JSON payloads (the v7 wire shape, so old
+    followers keep working) and base64 for msgpack payloads, which
+    JSON cannot hold losslessly."""
+    out = {"seq": r["seq"], "term": r["term"], "chain": r["chain"]}
+    payload = r["payload"]
+    if codec == protocol.CODEC_BINARY:
+        out["payload"] = payload
+    elif payload[:1] == b"{":
+        out["payload"] = payload.decode()
+    else:
+        out["payload"] = base64.b64encode(payload).decode()
+        out["b64"] = True
+    return out
+
+
+def _shipped_payload(rec: dict) -> bytes:
+    """Inverse of :func:`_ship_record` — the exact leader bytes."""
+    payload = rec["payload"]
+    if isinstance(payload, (bytes, bytearray)):
+        return bytes(payload)
+    if rec.get("b64"):
+        return base64.b64decode(payload)
+    return payload.encode()
 
 
 def quorum_of(replica_count: int) -> int:
@@ -278,7 +309,9 @@ class ReplicationCoordinator:
     def _follower_entry(self, follower_id: str, url: str) -> dict:
         # requires-lock: self._cv
         entry = self._followers.setdefault(
-            follower_id, {"acked": 0, "seen": 0.0, "url": ""}
+            follower_id,
+            {"acked": 0, "seen": 0.0, "url": "",
+             "codec": protocol.CODEC_JSON},
         )
         if url:
             entry["url"] = url
@@ -309,13 +342,15 @@ class ReplicationCoordinator:
 
     def pull(self, follower_id: str, after_seq: int, after_chain: int,
              wait_s: float, max_records: int = _PULL_MAX,
-             url: str = "") -> dict:
+             url: str = "",
+             codec: str = protocol.CODEC_JSON) -> dict:
         """One ``repl_append`` long-poll.  The cursor doubles as an ack."""
         from volcano_tpu import faults
 
         deadline = time.monotonic() + max(0.0, min(wait_s, 30.0))
         with self._cv:
             entry = self._follower_entry(follower_id, url)
+            entry["codec"] = codec
             if after_seq > entry["acked"]:
                 entry["acked"] = after_seq
             entry["seen"] = time.monotonic()
@@ -358,11 +393,7 @@ class ReplicationCoordinator:
             # followers hold and others do not
             records = []
         return {
-            "records": [
-                {"payload": r["payload"].decode(), "seq": r["seq"],
-                 "term": r["term"], "chain": r["chain"]}
-                for r in records
-            ],
+            "records": [_ship_record(r, codec) for r in records],
             "commit_seq": commit,
             "leader_seq": last_seq,
         }
@@ -399,6 +430,7 @@ class ReplicationCoordinator:
                     "acked_seq": f["acked"],
                     "lag_entries": lag_entries,
                     "lag_ms": lag_ms,
+                    "codec": f.get("codec", protocol.CODEC_JSON),
                 }
             return out
 
@@ -519,11 +551,38 @@ class _RawClient:
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.timeout = timeout
         self._req_id = 0
+        self.codec = protocol.CODEC_JSON
+        self._negotiate_codec()
+
+    def _negotiate_codec(self) -> None:
+        """Same ladder discipline as ``RemoteAPIServer``: offer binary,
+        and on ANY non-binary answer — a v7 leader rejecting the op, a
+        JSON-pinned leader, a connection blip mid-hello — degrade to
+        JSON rather than error.  A blip leaves the socket for the pull
+        loop's failure budget to judge."""
+        if not protocol.HAS_BINARY:
+            return
+        try:
+            resp = self.call({
+                "op": "bus_hello",
+                "codecs": [protocol.CODEC_BINARY, protocol.CODEC_JSON],
+            })
+        except ApiError as e:
+            if not isinstance(e, BusError) and "unknown bus op" in str(e):
+                metrics.register_bus_codec_fallback()
+            return
+        except OSError:
+            return
+        if resp.get("codec") == protocol.CODEC_BINARY:
+            self.codec = protocol.CODEC_BINARY
+        else:
+            metrics.register_bus_codec_fallback()
 
     def call(self, payload: dict, timeout: Optional[float] = None) -> dict:
         self._req_id += 1
         self.sock.settimeout(timeout if timeout is not None else self.timeout)
-        protocol.send_frame(self.sock, protocol.T_REQ, self._req_id, payload)
+        protocol.send_frame(self.sock, protocol.T_REQ, self._req_id, payload,
+                            codec=self.codec)
         while True:
             mtype, corr_id, resp = protocol.recv_frame(self.sock)
             if corr_id != self._req_id:
@@ -732,7 +791,8 @@ class ReplicaManager:
                 raise ApiError(f"not leader ({self.role})")
             return coord
 
-    def handle_append(self, payload: dict) -> dict:
+    def handle_append(self, payload: dict,
+                      codec: str = protocol.CODEC_JSON) -> dict:
         coord = self._coordinator_or_raise()
         resp = coord.pull(
             str(payload.get("id", "")),
@@ -741,6 +801,7 @@ class ReplicaManager:
             float(payload.get("wait_s", 0.0)),
             int(payload.get("max", _PULL_MAX)),
             url=str(payload.get("url", "")),
+            codec=codec,
         )
         resp["term"] = self.store.term
         resp["epoch"] = self.store.epoch
@@ -1415,5 +1476,5 @@ class ReplicaManager:
             # already holds every record durable, so batch-tail fsync
             # loses nothing a leader failure wouldn't re-ship
             self.store.apply_replica_record(
-                rec["payload"].encode(), sync=(i == last)
+                _shipped_payload(rec), sync=(i == last)
             )

@@ -64,15 +64,18 @@ class _CachedPayload:
     O(subscribers)).  The correlation id lives in the frame header, so
     the cached body bytes are shared verbatim; the watch_batch coalescer
     splices the per-watch id into the cached bytes instead of re-
-    encoding (see ``_Conn.write_loop``).  Lazily computed on the first
-    writer that ships it; the unsynchronized benign race can at worst
-    serialize twice."""
+    encoding (see ``_Conn.write_loop``).  Each codec caches its own
+    bytes: a mixed fleet (binary schedulers + a JSON-only dashboard)
+    costs one encode per codec per event, never one per subscriber.
+    Lazily computed on the first writer that ships it; the
+    unsynchronized benign race can at worst serialize twice."""
 
-    __slots__ = ("obj", "_raw")
+    __slots__ = ("obj", "_raw", "_raw_bin")
 
     def __init__(self, obj: dict):
         self.obj = obj
         self._raw: Optional[bytes] = None
+        self._raw_bin: Optional[bytes] = None
 
     def raw(self) -> bytes:
         body = self._raw
@@ -81,12 +84,55 @@ class _CachedPayload:
             self._raw = body
         return body
 
+    def raw_bin(self) -> bytes:
+        body = self._raw_bin
+        if body is None:
+            body = protocol.encode_payload(self.obj, protocol.CODEC_BINARY)
+            self._raw_bin = body
+        return body
+
+    def raw_for(self, codec: str) -> bytes:
+        return self.raw_bin() if codec == protocol.CODEC_BINARY else self.raw()
+
 
 def _splice_watch_id(body: bytes, watch_id: int) -> bytes:
     """``{"seq":...}`` → ``{"watch_id":N,"seq":...}`` by byte surgery —
     the batch entry a v3 client decodes as ``dict(entry, watch_id=N)``,
     without re-serializing the (shared, cached) entry body."""
     return b'{"watch_id":' + str(watch_id).encode() + b"," + body[1:]
+
+
+def _splice_watch_id_bin(body: bytes, watch_id: int) -> bytes:
+    """The msgpack twin of :func:`_splice_watch_id`: prepend a
+    ``watch_id`` key to a cached map body by bumping the map-header
+    count and splicing the packed pair in front of the existing
+    entries — the entry body itself stays the shared cached bytes."""
+    import msgpack
+
+    marker = body[0]
+    pair = b"\xa8watch_id" + msgpack.packb(watch_id)
+    if 0x80 <= marker < 0x8F:
+        # fixmap with room for one more pair
+        return bytes((marker + 1,)) + pair + body[1:]
+    if marker == 0x8F:
+        # fixmap at capacity: promote to map16
+        return b"\xde\x00\x10" + pair + body[1:]
+    if marker == 0xDE:
+        count = int.from_bytes(body[1:3], "big")
+        return b"\xde" + (count + 1).to_bytes(2, "big") + pair + body[3:]
+    # map32 or a non-map body: fall back to decode/re-encode
+    entry = msgpack.unpackb(body, raw=False)
+    entry["watch_id"] = watch_id
+    return msgpack.packb(entry, use_bin_type=True)
+
+
+def _batch_body_bin(parts: List[bytes]) -> bytes:
+    """Assemble ``{"events": [...]}`` in msgpack from pre-spliced entry
+    bodies — the binary equivalent of the JSON join below, still zero
+    re-encode.  ``len(parts) <= _WATCH_BATCH_MAX < 65536``."""
+    n = len(parts)
+    head = bytes((0x90 | n,)) if n < 16 else b"\xdc" + n.to_bytes(2, "big")
+    return b"\x81\xa6events" + head + b"".join(parts)
 
 
 class _Conn:
@@ -108,6 +154,12 @@ class _Conn:
         #: the first watch response is pushed, read only by the writer —
         #: a plain flag, no lock needed.
         self.batch_watch = False
+        #: negotiated body codec (protocol v8 ``bus_hello``).  Every
+        #: connection starts JSON — the pre-v8 wire format — and flips
+        #: to binary only when the peer asked for it; frames are
+        #: self-describing (stamped per frame), so the flip has no
+        #: ordering hazard with in-flight responses.
+        self.codec = protocol.CODEC_JSON
         #: watch_id → kind, for cleanup on close
         self.watches: Dict[int, str] = {}
         #: review_id → waiter, resolved by T_ADMIT_RESP frames
@@ -163,12 +215,14 @@ class _Conn:
             # store-side notifier — a slow wire must never stall the
             # store (the decoupling this queue exists for)
             time.sleep(fp.param_ms("bus.delay") / 1e3)
+        codec = self.codec
         try:
             if isinstance(payload, _CachedPayload):
-                protocol.send_frame_raw(self.sock, mtype, corr_id,
-                                        payload.raw())
+                body = payload.raw_for(codec)
             else:
-                protocol.send_frame(self.sock, mtype, corr_id, payload)
+                body = protocol.encode_payload(payload, codec)
+            protocol.send_frame_raw(self.sock, mtype, corr_id, body, codec)
+            metrics.observe_bus_frame_bytes(codec, len(body))
             return True
         except (OSError, ValueError):
             self.kill()
@@ -176,14 +230,17 @@ class _Conn:
 
     def _send_raw(self, mtype: int, corr_id: int, body: bytes) -> bool:
         """Pre-assembled body variant of :meth:`_send` (the watch-batch
-        splice path); same delay injection and failure semantics."""
+        splice path); the body is already in this connection's codec.
+        Same delay injection and failure semantics."""
         from volcano_tpu import faults
 
         fp = faults.get_plane()
         if fp.enabled and fp.should("bus.delay"):
             time.sleep(fp.param_ms("bus.delay") / 1e3)
         try:
-            protocol.send_frame_raw(self.sock, mtype, corr_id, body)
+            protocol.send_frame_raw(self.sock, mtype, corr_id, body,
+                                    self.codec)
+            metrics.observe_bus_frame_bytes(self.codec, len(body))
             return True
         except (OSError, ValueError):
             self.kill()
@@ -231,16 +288,19 @@ class _Conn:
                 ok = self._send(mtype, corr_id, payload)
             else:
                 metrics.observe_watch_batch(len(batch))
+                binary = self.codec == protocol.CODEC_BINARY
+                splice = _splice_watch_id_bin if binary else _splice_watch_id
                 parts = []
                 for wid, p in batch:
                     body = (
-                        p.raw() if isinstance(p, _CachedPayload)
-                        else protocol.encode_payload(p)
+                        p.raw_for(self.codec) if isinstance(p, _CachedPayload)
+                        else protocol.encode_payload(p, self.codec)
                     )
-                    parts.append(_splice_watch_id(body, wid))
+                    parts.append(splice(body, wid))
                 ok = self._send_raw(
                     protocol.T_WATCH_BATCH, 0,
-                    b'{"events":[' + b",".join(parts) + b"]}",
+                    _batch_body_bin(parts) if binary
+                    else b'{"events":[' + b",".join(parts) + b"]}",
                 )
             if not ok:
                 return
@@ -301,6 +361,10 @@ class BusServer:
         self._review_lock = threading.Lock()
         self._central_watchers: List[Tuple[str, object]] = []
         self._listener: Optional[socket.socket] = None
+        #: same-host shared-memory ring listener (bus/shm.py), opened
+        #: next to the TCP listener when VTPU_BUS_SHM is set; None when
+        #: the transport is off or could not come up (TCP still serves)
+        self._shm_listener = None
         self._threads: List[threading.Thread] = []
         self._conns: List[_Conn] = []  # guarded-by: self._conns_lock
         self._conns_lock = threading.Lock()
@@ -359,11 +423,30 @@ class BusServer:
         self._threads = [accept, bookmark]
         accept.start()
         bookmark.start()
+        from volcano_tpu.bus import shm
+
+        if shm.shm_enabled():
+            # same-host ring transport: rendezvous derived from the TCP
+            # port, so clients need no extra discovery.  Failure to come
+            # up is never fatal — TCP serves regardless.
+            try:
+                self._shm_listener = shm.ShmListener(self.port).start(
+                    self._adopt_conn)
+                log.info("bus shm rings at %s", self._shm_listener.dir)
+            except Exception as e:  # noqa: BLE001 — transport is optional
+                log.warning("bus shm listener unavailable (%s); TCP only", e)
+                self._shm_listener = None
         log.info("bus serving on %s:%d (epoch %s)", self.host, self.port, self.epoch[:8])
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        if self._shm_listener is not None:
+            try:
+                self._shm_listener.stop()
+            except OSError:
+                pass
+            self._shm_listener = None
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -462,16 +545,22 @@ class BusServer:
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            conn = _Conn(sock, peer)
-            with self._conns_lock:
-                self._conns.append(conn)
-            threading.Thread(
-                target=conn.write_loop, name="vtpu-bus-writer", daemon=True
-            ).start()
-            threading.Thread(
-                target=self._serve_conn, args=(conn,),
-                name="vtpu-bus-handler", daemon=True,
-            ).start()
+            self._adopt_conn(sock, peer)
+
+    def _adopt_conn(self, sock, peer) -> None:
+        """Register a transport-agnostic connection (TCP accept or shm
+        attach) and start its writer + handler threads."""
+        conn = _Conn(sock, peer)
+        with self._conns_lock:
+            self._conns.append(conn)
+        self._update_codec_gauge()
+        threading.Thread(
+            target=conn.write_loop, name="vtpu-bus-writer", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._serve_conn, args=(conn,),
+            name="vtpu-bus-handler", daemon=True,
+        ).start()
 
     def _serve_conn(self, conn: _Conn) -> None:
         try:
@@ -514,6 +603,7 @@ class BusServer:
         with self._conns_lock:
             if conn in self._conns:
                 self._conns.remove(conn)
+        self._update_codec_gauge()
         with self.api.locked():
             for watch_id, kind in conn.watches.items():
                 subs = self._subs.get(kind, [])
@@ -533,6 +623,14 @@ class BusServer:
         metrics.update_bus_server_watchers(
             sum(len(s) for s in self._subs.values())
         )
+
+    def _update_codec_gauge(self) -> None:
+        with self._conns_lock:
+            counts = {protocol.CODEC_JSON: 0, protocol.CODEC_BINARY: 0}
+            for c in self._conns:
+                counts[c.codec] = counts.get(c.codec, 0) + 1
+        for codec, count in counts.items():
+            metrics.update_bus_codec_connections(codec, count)
 
     # ---- request dispatch ----
 
@@ -603,6 +701,18 @@ class BusServer:
 
         api = self.api
         replica = self.replica
+        if op == "bus_hello":
+            # v8 codec negotiation — answered locally by ANY role (the
+            # codec is a property of THIS connection, not of the store).
+            # The reply rides the freshly negotiated codec; frames are
+            # self-describing, so the client decodes it either way.
+            offered = payload.get("codecs") or ()
+            if protocol.HAS_BINARY and protocol.CODEC_BINARY in offered:
+                conn.codec = protocol.CODEC_BINARY
+            else:
+                conn.codec = protocol.CODEC_JSON
+            self._update_codec_gauge()
+            return {"codec": conn.codec, "version": protocol.VERSION}
         if replica is not None and not replica.is_leader:
             if op in self._LEADER_OPS:
                 if payload.get("proxied"):
@@ -628,7 +738,10 @@ class BusServer:
         if op == "repl_append":
             if replica is None:
                 raise ApiError("replication not enabled")
-            return replica.handle_append(payload)
+            # the connection's codec decides HOW record payloads ship
+            # (raw bytes on binary connections, text/base64 on JSON) —
+            # see ReplicationCoordinator.pull for the byte-verbatim rule
+            return replica.handle_append(payload, codec=conn.codec)
         if op == "repl_snapshot":
             if replica is None:
                 raise ApiError("replication not enabled")

@@ -110,6 +110,20 @@ def read_records(path: str) -> Tuple[List[bytes], int, bool]:
     return payloads, offset, torn
 
 
+def record_codec() -> Optional[str]:
+    """Body codec for NEW WAL records (recovery sniffs per record, so
+    reads never need this).  ``VTPU_WAL_CODEC=json`` pins JSON — the
+    escape hatch for replicating to a follower too old to decode
+    msgpack record bytes; the default (``None``) lets
+    ``protocol.encode_record`` pick binary when msgpack is available."""
+    from volcano_tpu.bus import protocol
+
+    forced = os.environ.get("VTPU_WAL_CODEC", "").strip().lower()
+    if forced in (protocol.CODEC_JSON, protocol.CODEC_BINARY):
+        return forced
+    return None
+
+
 def store_digest(api: APIServer) -> str:
     """Canonical content digest of a store: every object of every kind,
     keyed and resourceVersion-stamped — the equality the crash-recovery
@@ -268,6 +282,8 @@ class PersistentAPIServer(APIServer):
 
     def _recover(self) -> None:
         # requires-lock: self._lock
+        from volcano_tpu.bus import protocol
+
         snap_path = self._snapshot_path()
         if os.path.exists(snap_path):
             with open(snap_path, encoding="utf-8") as f:
@@ -279,7 +295,9 @@ class PersistentAPIServer(APIServer):
         payloads, valid_len, torn = read_records(self._wal_path())
         self.recovered["torn"] = torn
         for payload in payloads:
-            rec = json.loads(payload.decode())
+            # codec sniffed per record: a log written by a JSON build
+            # recovers under a binary-default one and vice versa
+            rec = protocol.decode_record(payload)
             if rec.get("term", 0) > self.term:
                 self.term = rec["term"]
             self._ingest_record(rec, payload, pend_notify=False)
@@ -554,6 +572,8 @@ class PersistentAPIServer(APIServer):
         commit — appending and waiting are split exactly so the config
         can take effect at append time (``_ingest_record``'s rule).  A
         failed append (``wal.write_fail``) applies nothing."""
+        from volcano_tpu.bus import protocol
+
         with self._lock:
             fp = _get_fault_plane()
             record = {
@@ -562,7 +582,7 @@ class PersistentAPIServer(APIServer):
                 "term": self.term,
                 "ts": time.time(),
             }
-            payload = json.dumps(record, separators=(",", ":")).encode()
+            payload = protocol.encode_record(record, codec=record_codec())
             self._append_wal(payload, fp)  # raises WalError → no change
             self.chain = zlib.crc32(payload, self.chain)
             self.event_seq += 1
@@ -610,7 +630,7 @@ class PersistentAPIServer(APIServer):
             "term": self.term,
             "ts": ts,
         }
-        payload = json.dumps(record, separators=(",", ":")).encode()
+        payload = protocol.encode_record(record, codec=record_codec())
         try:
             self._append_wal(payload, fp)
         except WalError:
@@ -782,8 +802,10 @@ class PersistentAPIServer(APIServer):
         defers the fsync to the batch tail (the leader already holds
         the record durable, so a follower crash between appends loses
         nothing a re-pull would not re-ship)."""
+        from volcano_tpu.bus import protocol
+
         with self._lock:
-            rec = json.loads(payload.decode())
+            rec = protocol.decode_record(payload)
             fp = _get_fault_plane()
             if fp.enabled and fp.should("wal.write_fail"):
                 raise WalError("fault-injected: wal append failed")
@@ -837,6 +859,8 @@ class PersistentAPIServer(APIServer):
             return list(self._recent)
 
     def bus_status(self) -> dict:
+        from volcano_tpu.bus import protocol
+
         with self._lock:
             try:
                 snap_size = os.path.getsize(self._snapshot_path())
@@ -856,6 +880,10 @@ class PersistentAPIServer(APIServer):
                 "snapshot_seq": self._snapshot_seq,
                 "last_fsync_ts": self.last_fsync_ts,
                 "last_fsync_ms": self.last_fsync_ms,
+                "wal_codec": record_codec() or (
+                    protocol.CODEC_BINARY if protocol.HAS_BINARY
+                    else protocol.CODEC_JSON
+                ),
                 **({
                     "membership_epoch": int(self.membership.get("epoch", 0)),
                     "membership": sorted(

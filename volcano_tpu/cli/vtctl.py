@@ -511,15 +511,19 @@ def _bus_status(vc: VolcanoClient, args, out) -> int:
           f"@ seq {st.get('snapshot_seq', 0)}", file=out)
     print(f"Last fsync:         {st.get('last_fsync_ms', 0)} ms "
           f"at {st.get('last_fsync_ts', 0)}", file=out)
+    if "wal_codec" in st:
+        print(f"WAL codec:          {st['wal_codec']}", file=out)
     followers = st.get("followers", {})
     if followers:
         print("Followers:", file=out)
-        print(f"  {'ID':<22}{'ACKED':<9}{'LAG':<7}{'LAG-MS':<9}", file=out)
+        print(f"  {'ID':<22}{'ACKED':<9}{'LAG':<7}{'LAG-MS':<9}"
+              f"{'CODEC':<7}", file=out)
         for fid in sorted(followers):
             f = followers[fid]
             print(
                 f"  {fid:<22}{f.get('acked_seq', 0):<9}"
-                f"{f.get('lag_entries', 0):<7}{f.get('lag_ms', 0):<9g}",
+                f"{f.get('lag_entries', 0):<7}{f.get('lag_ms', 0):<9g}"
+                f"{f.get('codec', 'json'):<7}",
                 file=out,
             )
     elif st.get("role") == "leader" and int(st.get("replicas", 1)) > 1:

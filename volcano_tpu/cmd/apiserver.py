@@ -300,10 +300,18 @@ def main(argv=None) -> int:
         "requests and export them as telemetry segments "
         "(volcano_tpu/obs; also VTPU_FLIGHT_RECORDER=1)",
     )
+    parser.add_argument(
+        "--shm", action="store_true",
+        help="also listen on the same-host shared-memory ring "
+        "transport (bus/shm.py; also VTPU_BUS_SHM=1 — what local_up "
+        "--multiproc sets); clients fall back to TCP on attach failure",
+    )
     args = parser.parse_args(argv)
     from volcano_tpu.cmd.daemon import apply_faults
 
     apply_faults(args.faults)
+    if args.shm:
+        os.environ["VTPU_BUS_SHM"] = "1"
 
     replicas = [u.strip() for u in args.replicas.split(",") if u.strip()]
     daemon = ApiServerDaemon(

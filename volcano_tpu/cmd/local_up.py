@@ -173,6 +173,13 @@ def multiproc_up(nodes: int = 3, node_cpu: str = "8", node_mem: str = "16Gi",
 
     from volcano_tpu.bus import connect_bus
 
+    # same-host topology ⇒ engage the shared-memory ring transport for
+    # every daemon (they inherit the environment via _spawn) AND for
+    # this process's own bus client.  VTPU_BUS_SHM=0 opts out; any
+    # attach failure falls back to TCP silently, so this is a fast
+    # path, never a new failure mode.
+    os.environ.setdefault("VTPU_BUS_SHM", "1")
+
     if bus_port == 0:
         bus_port = _free_port(listen_host)
     procs: List[subprocess.Popen] = []

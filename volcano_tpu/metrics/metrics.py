@@ -442,6 +442,39 @@ def update_bus_watch_lag(seconds: float) -> None:
     ).observe(max(seconds, 0.0) * 1e3)
 
 
+#: frame-size buckets (bytes): watch entries are hundreds of bytes,
+#: relist replies and batch frames reach megabytes
+_FRAME_BYTE_BUCKETS = [64, 256, 1024, 4096, 16384, 65536, 262144,
+                       1048576, 4194304]
+
+
+def update_bus_codec_connections(codec: str, count: int) -> None:
+    """volcano_bus_codec: live server-side connections per negotiated
+    body codec (protocol v8 ``bus_hello``)."""
+    # label-vocab: codec ∈ {json, binary}, a static set
+    registry.set_gauge(f"{_NAMESPACE}_bus_codec", {"codec": codec}, count)
+
+
+def observe_bus_frame_bytes(codec: str, nbytes: int) -> None:
+    """volcano_bus_frame_bytes: serialized body size of one outbound
+    server frame, by codec — the byte half of the codec win the
+    serde-floor bench measures in time."""
+    # label-vocab: codec ∈ {json, binary}, a static set
+    registry.histogram(
+        f"{_NAMESPACE}_bus_frame_bytes", {"codec": codec},
+        buckets=_FRAME_BYTE_BUCKETS,
+    ).observe(nbytes)
+
+
+def register_bus_codec_fallback() -> None:
+    """volcano_bus_codec_fallbacks_total: a client offered binary and
+    the peer declined (pre-v8 server, msgpack-less build, or an
+    explicit JSON answer) — the connection degraded to JSON.  A
+    non-zero rate in a fleet that should be all-binary is version skew
+    made visible."""
+    registry.inc(f"{_NAMESPACE}_bus_codec_fallbacks_total", {})
+
+
 # ---- replicated persistent bus (bus/wal.py + bus/replication.py) ----
 # The durability plane's vital signs: fsync cost (the floor under every
 # acked write), WAL growth between snapshots, replication lag, the
