@@ -26,6 +26,31 @@ Usage::
     JAX_PLATFORMS=cpu python bench/loadgen.py --quick
     python bench/loadgen.py --rate 2000 --duration 30 --nodes 1000
     python bench/loadgen.py --find-saturation --slo-ms 100
+
+The O(pending) resident drill (``--resident-sweep``; CI runs it at
+``--quick`` shape and uploads the JSON as the ``resident-slo``
+artifact) grows the already-Running job population 10× while the
+pending stream stays constant, and gates p99 submit→bind within 1.2×
+and the restricted session-open mean within 2×::
+
+    JAX_PLATFORMS=cpu python bench/loadgen.py --quick --resident-sweep
+
+The full 100k-node / 1M-resident-job campaign is a slow/bench recipe,
+not a CI job — run it on a real machine with ~1h and tens of GB of
+RAM.  Preloading 1M pods through the store dominates setup time;
+budget ~20 min before the measured stream starts::
+
+    JAX_PLATFORMS=cpu python bench/loadgen.py \\
+        --nodes 100000 --node-cpu 64 \\
+        --resident 100000 --resident-sweep \\
+        --rate 200 --duration 60 --drain-timeout 600 \\
+        --warmup-timeout 1200 --period 30
+
+(``--resident 100000`` sweeps 100k → 1M resident jobs; ``--period
+30`` keeps the periodic full-session re-equilibration — which stays
+O(resident) by design — from swamping the run.  Track ``rss_bytes``
+per member across the sweep for the memory half of the headline; a
+federated variant adds ``--shards 4`` and reads per-process RSS.)
 """
 
 from __future__ import annotations
@@ -69,11 +94,14 @@ class LoadgenTopology:
 
     def __init__(self, n_nodes: int, node_cpu: int, conf_path: str,
                  period: float, debounce_ms: float,
-                 micro_cycles: bool = True):
-        self._init_store(n_nodes, node_cpu)
-        self._start_scheduler(conf_path, period, debounce_ms, micro_cycles)
+                 micro_cycles: bool = True, restricted: bool = False,
+                 resident: int = 0):
+        self._init_store(n_nodes, node_cpu, resident=resident)
+        self._start_scheduler(conf_path, period, debounce_ms, micro_cycles,
+                              restricted=restricted)
 
-    def _init_store(self, n_nodes: int, node_cpu: int) -> None:
+    def _init_store(self, n_nodes: int, node_cpu: int,
+                    resident: int = 0) -> None:
         from volcano_tpu.bus.server import BusServer
         from volcano_tpu.client import (
             ADDED,
@@ -101,6 +129,29 @@ class LoadgenTopology:
                 _build_node(_node_name(i), {"cpu": str(node_cpu),
                                             "memory": "256Gi"})
             )
+
+        #: ``--resident``: preload N already-Running single-task jobs
+        #: (pods pre-bound round-robin, 1m/1Mi requests so they occupy
+        #: jobs, not capacity) BEFORE the scheduler attaches — the
+        #: resident ballast the incremental-session plane claims not to
+        #: pay per cycle.  The reaper skips them (fixed population).
+        self.n_resident = resident
+        if resident:
+            from volcano_tpu.apis import scheduling
+
+        for i in range(resident):
+            name = f"resident-r{i:06d}"
+            pg = _build_pod_group("ns", name, 1)
+            # already Running at store truth — a fresh Inqueue phase
+            # would make the first full cycle write back O(resident)
+            # phase migrations, which no real resident population pays
+            pg.status.phase = scheduling.POD_GROUP_RUNNING
+            self.vc.create_pod_group(pg)
+            pod = _build_pod("ns", f"{name}-t0",
+                             {"cpu": "1m", "memory": "1Mi"}, group=name)
+            pod.spec.node_name = _node_name(i % n_nodes)
+            pod.status.phase = "Running"
+            self.kube.create_pod(pod)
 
         #: ns/name → wall-clock the bind landed at store truth
         self.bind_ts: Dict[str, float] = {}
@@ -132,7 +183,8 @@ class LoadgenTopology:
         self._reaper.start()
 
     def _start_scheduler(self, conf_path: str, period: float,
-                         debounce_ms: float, micro_cycles: bool) -> None:
+                         debounce_ms: float, micro_cycles: bool,
+                         restricted: bool = False) -> None:
         from volcano_tpu.bus.remote import RemoteAPIServer
         from volcano_tpu.cache import SchedulerCache
         from volcano_tpu.client import SchedulerClient
@@ -149,6 +201,7 @@ class LoadgenTopology:
         self.scheduler = Scheduler(
             self.cache, scheduler_conf_path=conf_path, period=period,
             micro_cycles=micro_cycles, micro_debounce_ms=debounce_ms,
+            restricted_sessions=restricted,
         )
         self._thread = threading.Thread(
             target=self.scheduler.run, name="loadgen-scheduler", daemon=True
@@ -168,6 +221,9 @@ class LoadgenTopology:
                 due = [
                     k for k, ts in self.bind_ts.items()
                     if ts <= cutoff and k not in reaped
+                    # resident ballast never completes — its population
+                    # is the controlled variable of --resident runs
+                    and not k.partition("/")[2].startswith("resident-")
                 ]
             for key in due:
                 ns, name = key.split("/", 1)
@@ -202,6 +258,11 @@ class LoadgenTopology:
     def bound_count(self, keys) -> int:
         with self._bind_lock:
             return sum(1 for k in keys if k in self.bind_ts)
+
+    def rss_report(self) -> Dict[str, int]:
+        """Resident-set size per scheduling member, bytes.  The
+        in-process topology's scheduler shares the harness process."""
+        return {"scheduler": _rss_bytes()}
 
     def close(self):
         self._reaper_stop.set()
@@ -238,10 +299,10 @@ class FederatedTopology(LoadgenTopology):
                  startup_timeout: float = 180.0,
                  log_dir: str = "",
                  n_members: int = 0,
-                 extra_flags=()):
+                 extra_flags=(), resident: int = 0):
         import subprocess
 
-        self._init_store(n_nodes, node_cpu)
+        self._init_store(n_nodes, node_cpu, resident=resident)
         self.n_shards = n_shards
         #: with ``n_members > n_shards`` the extra schedulers run as
         #: warm STANDBYS: registered members that hold no slice until
@@ -315,6 +376,15 @@ class FederatedTopology(LoadgenTopology):
         proc.kill()
         proc.wait(timeout=10)
         return f"shard{index}"
+
+    def rss_report(self) -> Dict[str, int]:
+        """RSS per member PROCESS — the resident-memory-per-member
+        number the 1M-job campaign tracks."""
+        return {
+            f"shard{i}": _rss_bytes(p.pid)
+            for i, p in enumerate(self.procs)
+            if p.poll() is None
+        }
 
     def shard_report(self) -> dict:
         from volcano_tpu.federation import read_shard_map
@@ -788,6 +858,16 @@ def _build_queue(name):
     )
 
 
+def _rss_bytes(pid="self") -> int:
+    """Resident-set size of a process in bytes (0 when unreadable —
+    e.g. a member that exited, or a non-/proc platform)."""
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGESIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
 # ---- the measured phase ----
 
 def run_phase(topo: LoadgenTopology, rate: float, duration: float,
@@ -963,6 +1043,28 @@ def _cycle_mix(topo: LoadgenTopology) -> dict:
     }
 
 
+def _session_stats(topo: LoadgenTopology) -> dict:
+    """Session-open cost + incremental-plane counters from the
+    in-process scheduler — the numbers the --resident-sweep gates."""
+    s = topo.scheduler
+    return {
+        "sessions_opened": s.sessions_opened,
+        "session_open_mean_ms": round(
+            s.session_open_seconds / max(s.sessions_opened, 1) * 1e3, 3),
+        "restricted_cycles": s.restricted_cycles_run,
+        "restricted_open_mean_ms": round(
+            s.restricted_open_seconds
+            / max(s.restricted_open_cycles, 1) * 1e3, 3),
+        # median: the steady-cycle cost — one GC/contention stall in a
+        # short CI run must not read as an O(resident) regression
+        "restricted_open_p50_ms": round(float(np.median(
+            s.restricted_open_samples)) * 1e3, 3)
+        if s.restricted_open_samples else 0.0,
+        "shadow_checks": s.shadow_checks_run,
+        "shadow_divergences": s.shadow_divergences,
+    }
+
+
 def _warm_names(label: str, n_shards: int):
     """Warm job names covering every home shard (so each federation
     member compiles its kernels off the clock, not on the first
@@ -1049,6 +1151,8 @@ def run_loadgen(args) -> dict:
                     "--autoscale-cooldown-s", "3.0",
                     "--autoscale-period-s", "0.5",
                 ]
+            if args.restricted_sessions:
+                ramp_flags = [*ramp_flags, "--restricted-sessions"]
             topo = FederatedTopology(
                 n_nodes=args.nodes, node_cpu=args.node_cpu,
                 conf_path=conf_path, period=args.period,
@@ -1058,6 +1162,7 @@ def run_loadgen(args) -> dict:
                 micro_cycles=not args.no_micro_cycles,
                 n_members=n_members,
                 extra_flags=ramp_flags,
+                resident=args.resident,
             )
         elif args.apiserver_replicas > 0:
             topo = ReplicatedBusTopology(
@@ -1074,6 +1179,8 @@ def run_loadgen(args) -> dict:
                 conf_path=conf_path, period=args.period,
                 debounce_ms=args.debounce_ms,
                 micro_cycles=not args.no_micro_cycles,
+                restricted=args.restricted_sessions,
+                resident=args.resident,
             )
         topo.complete_after_s = args.complete_after_s
         return topo
@@ -1169,6 +1276,9 @@ def run_loadgen(args) -> dict:
             )
             if hasattr(topo, "scheduler"):
                 report.update(_cycle_mix(topo))
+                report.update(_session_stats(topo))
+            report["resident_jobs"] = getattr(topo, "n_resident", 0)
+            report["rss_bytes"] = topo.rss_report()
             if args.stage_breakdown and hasattr(topo, "scheduler"):
                 report["stage_breakdown"] = _stage_breakdown(topo)
             if args.apiserver_replicas > 0:
@@ -1265,6 +1375,202 @@ def run_loadgen(args) -> dict:
             else out["run"]["achieved_pods_per_s"]
         )
     return out
+
+
+def run_resident_sweep(args) -> dict:
+    """The O(pending) flagship drill: hold the pending stream constant
+    (same rate, duration, fleet) while the RESIDENT (already-Running)
+    job population grows 10×, and require the restricted-session
+    scheduler's user-visible numbers to stay put.
+
+    Three runs on fresh topologies:
+
+      1. ``full_baseline``  — full sessions,       ``--resident`` jobs
+      2. ``restricted_1x``  — restricted sessions, ``--resident`` jobs
+      3. ``restricted_10x`` — restricted sessions, 10 × ``--resident``
+
+    Gates (judged in main, printed as ``LOADGEN FAIL:``):
+
+      * every submitted pod bound, in all three runs;
+      * zero shadow-cross-check divergences in the restricted runs
+        (and the restricted runs must actually run restricted cycles);
+      * p99 submit→bind: restricted_10x ≤ 1.2 × restricted_1x
+        (+ a small absolute grace for timer noise at CI shape);
+      * steady-cycle open cost: restricted-cycle session-open MEDIAN
+        of restricted_10x ≤ 2 × restricted_1x (+0.25ms timer-noise
+        floor).  Periodic FULL cycles stay O(resident) by design, so
+        the gate reads the restricted-only samples, not the blended
+        mean — and the median, so one GC stall in a short CI run
+        doesn't read as an O(resident) regression.
+
+    Two O(resident)-BY-DESIGN costs are deliberately kept off the
+    measured clock, in both the 1x and 10x runs, so the gates read the
+    steady-state plane and not the amortized maintenance:
+
+      * periodic full-session re-equilibration — run the sweep with
+        ``--period`` longer than the stream (the quick preset and the
+        campaign recipe both do);
+      * shadow cross-check audits (each one opens a FULL session over
+        the same snapshot) — sampling is disabled during the stream,
+        then forced to EVERY cycle for a burst of post-drain audit
+        jobs, so each restricted run still proves zero divergence on
+        live traffic (``shadow_checks`` ≥ 1 is itself gated).
+    """
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as f:
+        f.write(CONF)
+        conf_path = f.name
+
+    # periodic full re-equilibration is O(resident) by design — one
+    # tick landing mid-stream adds a full-session stall to whatever
+    # pods collide with it, which is maintenance cost, not the
+    # steady-state plane the gates read.  Push it past the run window
+    # (identically in all three runs; full_baseline still measures
+    # full-session cost, every micro wake opens one there).
+    period = max(args.period,
+                 4.0 * (args.duration + args.drain_timeout
+                        + args.warmup_timeout))
+
+    def one(resident: int, restricted: bool, label: str) -> dict:
+        topo = LoadgenTopology(
+            n_nodes=args.nodes, node_cpu=args.node_cpu,
+            conf_path=conf_path, period=period,
+            debounce_ms=args.debounce_ms,
+            micro_cycles=not args.no_micro_cycles,
+            restricted=restricted, resident=resident,
+        )
+        topo.complete_after_s = args.complete_after_s
+        try:
+            if restricted:
+                # shadow sampling off during the measured stream (see
+                # the docstring); the audit burst below re-enables it
+                topo.scheduler.shadow_every = 0
+            # same warmup contract as run_loadgen: kernels compile off
+            # the clock, so the first measured pod pays scheduling, not
+            # jit
+            deadline = time.monotonic() + args.warmup_timeout
+            for wi, burst in enumerate((4, 24)):
+                warm = []
+                for name in _warm_names(f"{label}w{wi}", 0):
+                    warm.extend(topo.submit_job(name, burst, args.cpu))
+                while time.monotonic() < deadline:
+                    if topo.bound_count(warm) == len(warm):
+                        break
+                    time.sleep(0.05)
+                if topo.bound_count(warm) != len(warm):
+                    raise RuntimeError("warmup pods never bound")
+            # quiesce: the gang warmup breaks a cycle window, and the
+            # NEXT window opens with an unconditional full cycle whose
+            # commit barrier drains the warm binds — all O(resident)
+            # effluent that must finish off the measured clock
+            settle = time.monotonic()
+            last = -1
+            while time.monotonic() < deadline:
+                n = topo.scheduler.sessions_opened
+                if n != last:
+                    last, settle = n, time.monotonic()
+                elif time.monotonic() - settle >= 0.6:
+                    break
+                time.sleep(0.1)
+            # GC off for the measured window (all three runs alike): a
+            # gen-2 collection over a 10x-resident heap is a ~100ms
+            # stop-the-world stall that lands on whatever pod is in
+            # flight — allocator noise, not scheduler behavior.  The
+            # window is short; refcounting still frees the bulk.
+            import gc
+
+            gc.collect()
+            gc.disable()
+            try:
+                report = run_phase(
+                    topo, args.rate, args.duration, args.tasks_per_job,
+                    args.cpu, args.drain_timeout, label=label,
+                )
+            finally:
+                gc.enable()
+            if restricted:
+                # forced-audit burst: every cycle now runs the shadow
+                # full-session cross-check, so the zero-divergence gate
+                # is proven on live traffic, off the measured clock
+                topo.scheduler.shadow_every = 1
+                audit = []
+                for i in range(3):
+                    audit.extend(
+                        topo.submit_job(f"{label}-audit-{i}", 1, args.cpu)
+                    )
+                deadline = time.monotonic() + args.drain_timeout
+                while time.monotonic() < deadline:
+                    if topo.bound_count(audit) == len(audit):
+                        break
+                    time.sleep(0.05)
+                if topo.bound_count(audit) != len(audit):
+                    raise RuntimeError("audit pods never bound")
+            report.update(_cycle_mix(topo))
+            report.update(_session_stats(topo))
+            report["resident_jobs"] = resident
+            report["restricted_sessions"] = restricted
+            report["rss_bytes"] = topo.rss_report()
+            return report
+        finally:
+            topo.close()
+
+    base = args.resident
+    return {
+        "harness": "loadgen-resident",
+        "config": {
+            "nodes": args.nodes,
+            "node_cpu": args.node_cpu,
+            "rate": args.rate,
+            "duration_s": args.duration,
+            "resident_base": base,
+            "p99_ratio_gate": 1.2,
+            "p99_grace_ms": args.resident_p99_grace_ms,
+            "open_cost_ratio_gate": 2.0,
+            "quick": args.quick,
+        },
+        "full_baseline": one(base, False, "f1x"),
+        "restricted_1x": one(base, True, "r1x"),
+        "restricted_10x": one(base * 10, True, "r10x"),
+    }
+
+
+def _resident_gates(report, grace_ms: float) -> list:
+    """Gate messages for a --resident-sweep report ([] = pass)."""
+    fails = []
+    for key in ("full_baseline", "restricted_1x", "restricted_10x"):
+        r = report[key]
+        if r["bound_pods"] != r["submitted_pods"]:
+            fails.append(
+                f"{key}: {r['submitted_pods'] - r['bound_pods']} pods "
+                "never bound"
+            )
+    r1 = report["restricted_1x"]
+    r10 = report["restricted_10x"]
+    for key, r in (("restricted_1x", r1), ("restricted_10x", r10)):
+        if r["restricted_cycles"] == 0:
+            fails.append(f"{key}: no restricted cycles ran — the sweep "
+                         "never exercised the incremental plane")
+        if r["shadow_checks"] == 0:
+            fails.append(f"{key}: no shadow cross-checks ran — the "
+                         "zero-divergence gate is vacuous")
+        if r["shadow_divergences"]:
+            fails.append(f"{key}: {r['shadow_divergences']} shadow "
+                         "cross-check divergences (ledger unsound)")
+    p99_gate = 1.2 * r1["p99_ms"] + grace_ms
+    if r10["p99_ms"] > p99_gate:
+        fails.append(
+            f"p99 regressed with 10x resident jobs: {r10['p99_ms']}ms > "
+            f"1.2 x {r1['p99_ms']}ms + {grace_ms}ms grace"
+        )
+    # median, not mean: the steady-cycle cost.  +0.25ms absolute
+    # grace — the timer-noise floor at CI shape.
+    open_gate = 2.0 * r1["restricted_open_p50_ms"] + 0.25
+    if r10["restricted_open_p50_ms"] > open_gate:
+        fails.append(
+            "restricted session-open cost is not O(pending): "
+            f"{r10['restricted_open_p50_ms']}ms median at 10x resident "
+            f"> 2 x {r1['restricted_open_p50_ms']}ms + 0.25ms grace"
+        )
+    return fails
 
 
 def main(argv=None) -> int:
@@ -1375,6 +1681,29 @@ def main(argv=None) -> int:
                    "kernel, commit flush, bus op, WAL fsync, quorum "
                    "wait, bind landing) from collected spans — the "
                    "per-stage report CI uploads next to the SLO JSON")
+    p.add_argument("--restricted-sessions", action="store_true",
+                   help="open RESTRICTED sessions (O(pending) "
+                   "micro-cycles over the share ledger, with sampled "
+                   "shadow full-session cross-checks) — in-process "
+                   "topologies flip the Scheduler flag, --shards "
+                   "members get the daemon flag (ignored by "
+                   "--apiserver-replicas runs)")
+    p.add_argument("--resident", type=int, default=0,
+                   help="preload this many already-Running single-task "
+                   "jobs before the scheduler attaches — the resident "
+                   "ballast the incremental-session plane must not pay "
+                   "per cycle (the reaper never completes them)")
+    p.add_argument("--resident-sweep", action="store_true",
+                   help="the O(pending) flagship drill: three runs at "
+                   "identical offered load — full sessions at "
+                   "--resident jobs, restricted at --resident, "
+                   "restricted at 10x --resident — gating p99 within "
+                   "1.2x and restricted session-open mean within 2x "
+                   "across the 10x resident growth, with zero shadow "
+                   "divergences")
+    p.add_argument("--resident-p99-grace-ms", type=float, default=10.0,
+                   help="absolute grace added to the sweep's 1.2x p99 "
+                   "gate (timer noise at CI shape)")
     p.add_argument("--quick", action="store_true",
                    help="CI smoke preset: small fleet, short stream")
     args = p.parse_args(argv)
@@ -1410,6 +1739,22 @@ def main(argv=None) -> int:
             # times over before churn can free it
             args.rate = 5.0
             args.drain_timeout = 120.0
+        if args.resident_sweep and args.resident == 0:
+            # 100 → 1000 resident jobs across the sweep: enough that an
+            # O(resident) open cost would blow the 2x gate, small
+            # enough for CI
+            args.resident = 100
+
+    if args.resident_sweep:
+        if args.resident <= 0:
+            p.error("--resident-sweep needs --resident > 0 (or --quick)")
+        report = run_resident_sweep(args)
+        json.dump(report, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        fails = _resident_gates(report, args.resident_p99_grace_ms)
+        for msg in fails:
+            print(f"LOADGEN FAIL: {msg}", file=sys.stderr)
+        return 1 if fails else 0
 
     report = run_loadgen(args)
     json.dump(report, sys.stdout, indent=2)

@@ -44,6 +44,7 @@ from volcano_tpu.client.apiserver import ConflictError
 from volcano_tpu.federation import (
     FederatedScheduler,
     read_shard_map,
+    SketchSolicitor,
     verify_federation,
 )
 from volcano_tpu.federation.filter import ShardInformerFilter
@@ -680,34 +681,58 @@ class TestGangBroker:
         ) == set()
 
     def test_plan_fills_home_first_and_accounts_claims(self):
+        from volcano_tpu.federation.sketches import entry_from_sketch
+
         rig = self._rig()
         home = _nodes_for_shard(0, 2, 1, cpu="4")[0]
-        foreign = _nodes_for_shard(1, 2, 1, cpu="16")[0]
         rig.filter.add_node(home)
-        rig.filter.add_node(foreign)
+        # foreign capacity arrives as a sketch topNodes entry — the
+        # ledger never holds foreign nodes anymore
+        foreign = entry_from_sketch({
+            "name": "foreign-n0", "freeCpuMilli": 16000.0,
+            "freeMemory": float(64 << 30), "slots": 8,
+        })
         tasks = [self._task(f"t{i}", cpu="3") for i in range(3)]
-        plan = rig.filter.plan_gang_assembly(tasks)
+        plan = rig.filter.plan_gang_assembly(
+            tasks, foreign_entries=[foreign]
+        )
         assert len(plan) == 3
         hosts = [h for _t, h in plan]
         # home fits exactly ONE 3-cpu claim (4 cpu total): the plan
         # debits its own claims, so the second task must go foreign
         assert hosts[0] == home.metadata.name
         assert hosts.count(home.metadata.name) == 1
-        assert hosts.count(foreign.metadata.name) == 2
+        assert hosts.count("foreign-n0") == 2
 
-    def test_plan_respects_shard_gate(self):
+    def test_plan_without_foreign_entries_is_home_only(self):
         rig = self._rig()
         rig.filter.add_node(_nodes_for_shard(0, 2, 1, cpu="2")[0])
+        # a foreign node on the watch feed is NOT a candidate source:
+        # the owned-slice ledger drops it, and with no sketch entries
+        # passed in the plan is home-only — one task stays unplaced
         rig.filter.add_node(_nodes_for_shard(1, 2, 1, cpu="16")[0])
         tasks = [self._task(f"t{i}", cpu="2") for i in range(2)]
-        # shard 1 gated out: only the home node places, one task left
-        plan = rig.filter.plan_gang_assembly(
-            tasks, shard_ok=lambda s: False
-        )
+        plan = rig.filter.plan_gang_assembly(tasks)
         assert len(plan) == 1
         assert plan[0][1] in {
             n.metadata.name for n in _nodes_for_shard(0, 2, 1)
         }
+
+    def test_foreign_entries_respect_shard_gate(self):
+        rig = self._rig()
+        sol = SketchSolicitor(rig.api, rig.state)
+        name = _nodes_for_shard(1, 2, 1)[0].metadata.name
+        rec = {
+            "shards": {"1": {"holder": "m1"}},
+            "stats": {"m1": {"sketch": {"topNodes": [{
+                "name": name, "freeCpuMilli": 16000.0,
+                "freeMemory": float(64 << 30), "slots": 8,
+            }]}}},
+        }
+        assert len(sol.foreign_entries(rec)) == 1
+        # the broker's solicitable_shards gate prunes the whole shard
+        # before its topNodes are even materialized
+        assert sol.foreign_entries(rec, shard_ok=lambda s: False) == []
 
     def _broker(self, rig, api=None):
         from volcano_tpu.federation import GangBroker
@@ -1256,6 +1281,7 @@ class TestVtctlShards:
                              "spillover": {"bound": 2, "conflict": 1},
                              "sketch": {"freeCpuMilli": 16000,
                                         "freeSlots": 4},
+                             "sketchChecks": {"stale": 1, "verified": 3},
                              "gangAssembly": {"committed": 1,
                                               "conflict": 2}}},
         }
@@ -1280,6 +1306,12 @@ class TestVtctlShards:
         assert "<unheld>" in direct.getvalue()
         # the gang-assembly line renders from the stats blob alone
         assert "gang-assembly: committed=1 conflict=2" in direct.getvalue()
+        # sketch freshness: age measured against the newest renew tick
+        # ON the map (stored fields only, part of the byte-identity
+        # assertion above), never a call-time clock
+        assert "sketch: slots=4 topNodes=0 age=0s/ttl=2s (fresh)" \
+            in direct.getvalue()
+        assert "sketch-checks: stale=1 verified=3" in direct.getvalue()
         # the autoscale line renders from stored fields alone — it is
         # part of the byte-identity assertion above
         assert "Autoscale:          target 2 (up:" in direct.getvalue()
@@ -1327,24 +1359,50 @@ class TestPolicyChecker:
         assert verify_federation(api, 1)["ok"]
 
 
-class TestSpilloverLedgerAccounting:
-    def test_ledger_tracks_bound_and_released_capacity(self):
-        state = ShardState(2)
-        state.acquire(0)
-        cache = SchedulerCache(scheduler_name="volcano-tpu")
-        filt = ShardInformerFilter(cache, state)
-        foreign = _nodes_for_shard(1, 2, 1, cpu="4")[0]
-        filt.add_node(foreign)
-        pod = build_pod("ns", "p1", foreign.metadata.name,
-                        {"cpu": "3", "memory": "1Gi"})
-        filt.add_pod(pod)
-        # 3 of 4 cpus used: a 2-cpu task no longer fits
+class TestSketchSpillCandidates:
+    """The sketch is the ONLY foreign state: the owner's published
+    capacity sketch shrinks and grows with its bound pods, and a
+    foreign member solicits spill candidates from that sketch alone —
+    the per-node foreign mirror no longer exists."""
+
+    def test_sketch_tracks_bound_and_released_capacity(self):
         from volcano_tpu.api.job_info import new_task_info
 
+        api = APIServer()
+        # the OWNER of shard 1 maintains the owned-slice ledger the
+        # sketch is cut from
+        owner_state = ShardState(2)
+        owner_state.acquire(1)
+        owner = ShardInformerFilter(
+            SchedulerCache(scheduler_name="volcano-tpu"), owner_state
+        )
+        node = _nodes_for_shard(1, 2, 1, cpu="4")[0]
+        KubeClient(api).create_node(node)  # store truth for verify_node
+        owner.add_node(node)
+        pod = build_pod("ns", "p1", node.metadata.name,
+                        {"cpu": "3", "memory": "1Gi"})
+        owner.add_pod(pod)
+
+        def rec():
+            # what the lease heartbeat would publish on the shard map
+            return {"shards": {"1": {"holder": "m1"}},
+                    "stats": {"m1": {"sketch": owner.capacity_sketch()}}}
+
+        # a FOREIGN member (owning shard 0) solicits from the sketch
+        state = ShardState(2)
+        state.acquire(0)
+        sol = SketchSolicitor(api, state)
         big = new_task_info(build_pod("ns", "want", "",
                                       {"cpu": "2", "memory": "1Gi"}))
-        assert filt.spill_candidates(big) == []
+        # 3 of 4 cpus used: a 2-cpu task no longer fits by the sketch
+        assert sol.spill_candidates(big, rec()) == []
         done = pod.clone()
         done.status.phase = "Succeeded"
-        filt.update_pod(pod, done)
-        assert filt.spill_candidates(big) == [foreign.metadata.name]
+        owner.update_pod(pod, done)
+        assert sol.spill_candidates(big, rec()) == [node.metadata.name]
+        # bind-time truth: the node exists and is schedulable
+        assert sol.verify_node(node.metadata.name)
+        assert sol.counters() == {"verified": 1}
+        # a vanished node reads stale — a pruning event, not an error
+        assert not sol.verify_node("no-such-node")
+        assert sol.counters() == {"verified": 1, "stale": 1}

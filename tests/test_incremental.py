@@ -1,0 +1,529 @@
+"""Incremental-session plane (ISSUE 18).
+
+The contracts under test:
+
+* **Binding equivalence (the property test)** — restricted sessions
+  (O(pending) micro-sessions over the share ledger's schedulable set)
+  bind EXACTLY what full sessions bind, across randomized churn:
+  bind/complete/join interleavings with gang and non-gang jobs mixed,
+  with the shadow full-session cross-check running on every cycle
+  (``shadow_every=1``) and recording zero divergence.
+* **Ledger exactness** — the incrementally-maintained per-queue /
+  per-namespace totals equal a from-scratch sweep of the resident jobs
+  bit-for-bit after arbitrary churn (the property that lets proportion
+  and DRF seed from the ledger instead of sweeping).
+* **The checker catches a broken ledger** — a planted read-time
+  corruption (``ShareLedger.plant_divergence``) makes the very next
+  shadow cross-check flag a divergence (and raise in strict mode);
+  clearing the plant heals the plane and the skipped work lands on the
+  following cycle.
+* **O(1) wake gate** — an idle wake (capacity freed with nothing
+  schedulable) opens NO session: the loop consults the ledger's
+  schedulable counter instead of rescanning every resident job, and a
+  subsequent real arrival still binds through the event wake.
+* **Metrics** — the four incremental-plane series export with their
+  pinned label vocabularies: ``volcano_resident_jobs`` /
+  ``volcano_schedulable_jobs`` gauges,
+  ``volcano_session_scope_total{mode}``, and
+  ``volcano_share_ledger_drift_checks_total{result}``.
+* **Federation mix** — restricted sessions stay divergence-free with
+  spillover and the cross-shard gang broker active on a 2-shard
+  federation (the ISSUE's "gang + spillover mixed" leg).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from volcano_tpu.api import TaskStatus
+from volcano_tpu.api.resource import empty_resource
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.client import APIServer, KubeClient, SchedulerClient, VolcanoClient
+from volcano_tpu.incremental import subgraph
+from volcano_tpu.incremental.shares import (
+    PLANT_DROP_SCHEDULABLE,
+    PLANT_INFLATE_ALLOCATED,
+)
+from volcano_tpu.metrics import metrics
+from volcano_tpu.scheduler.scheduler import Scheduler
+
+from tests.builders import build_node, build_pod, build_pod_group, build_queue
+
+CONF = """
+actions: "enqueue, jax-allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+
+def _wait(pred, timeout=30.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _counter(suffix: str, **labels) -> float:
+    want = tuple(sorted(labels.items()))
+    with metrics.registry._lock:
+        return sum(
+            v for (name, lbl), v in metrics.registry._counters.items()
+            if name.endswith(suffix) and (not want or lbl == want)
+        )
+
+
+class IncCluster:
+    """One scheduler over an in-process store, with the restricted
+    incremental-session plane switchable per instance.  Restricted
+    instances shadow-check EVERY cycle (``shadow_every=1``) — the test
+    posture the ISSUE pins, vs sampled in production."""
+
+    def __init__(self, tmp_path, name, restricted=True, shadow_every=1,
+                 n_nodes=6, node_cpu="32", period=30.0):
+        self.api = APIServer()
+        self.kube = KubeClient(self.api)
+        self.vc = VolcanoClient(self.api)
+        self.vc.create_queue(build_queue("default"))
+        self.n_nodes = n_nodes
+        for i in range(n_nodes):
+            self.kube.create_node(build_node(
+                f"n{i}", {"cpu": node_cpu, "memory": "64Gi"},
+            ))
+        self.cache = SchedulerCache(
+            client=SchedulerClient(self.api), scheduler_name="volcano-tpu",
+        )
+        conf = tmp_path / f"{name}-conf.yaml"
+        conf.write_text(CONF)
+        self.scheduler = Scheduler(
+            self.cache, scheduler_conf_path=str(conf), period=period,
+            micro_cycles=True, micro_debounce_ms=5.0,
+            restricted_sessions=restricted, shadow_every=shadow_every,
+        )
+        self.cache.run()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.scheduler.run, name="inc-scheduler", daemon=True
+        )
+        self._thread.start()
+        assert _wait(lambda: self.scheduler.full_cycles_run >= 1)
+        return self
+
+    def submit(self, name, replicas=1, cpu="1", gang=False):
+        self.vc.create_pod_group(
+            build_pod_group("ns", name, replicas if gang else 1)
+        )
+        for i in range(replicas):
+            self.kube.create_pod(build_pod(
+                "ns", f"{name}-t{i}", "", {"cpu": cpu, "memory": "1Gi"},
+                group=name,
+            ))
+
+    def complete(self, name, replicas):
+        """Job departure, loadgen-reaper style: pods then the group."""
+        for i in range(replicas):
+            self.kube.delete_pod("ns", f"{name}-t{i}")
+        self.vc.delete_pod_group("ns", name)
+
+    def binding_map(self):
+        return {
+            f"{p.metadata.namespace}/{p.metadata.name}": p.spec.node_name
+            for p in self.kube.list_pods("ns")
+            if p.spec.node_name
+        }
+
+    def all_placed(self):
+        pods = self.kube.list_pods("ns")
+        return all(p.spec.node_name for p in pods)
+
+    def close(self):
+        self.scheduler.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            assert not self._thread.is_alive()
+        self.cache.stop_commit_plane()
+
+
+class TestRestrictedEquivalence:
+    @pytest.mark.parametrize("seed", [11, 29, 47])
+    def test_randomized_churn_binding_identical(self, tmp_path, seed):
+        """Drive a restricted cluster and a full cluster through the
+        same randomized op sequence — joins (gang and non-gang),
+        per-round cycles, completions of previously-bound jobs — and
+        require identical binding maps at every step.  Every restricted
+        cycle is also shadow cross-checked against a full session over
+        the same snapshot: the zero-divergence count is the per-cycle
+        equivalence evidence, the cross-cluster map compare the
+        end-to-end one."""
+        restricted = IncCluster(tmp_path, f"re-{seed}", restricted=True)
+        full = IncCluster(tmp_path, f"fu-{seed}", restricted=False)
+        rng = random.Random(seed)
+        live = []  # (name, replicas) submitted and expected bound
+        try:
+            for round_i in range(6):
+                for _ in range(rng.randint(1, 3)):
+                    name = f"j{round_i}-{rng.randrange(1 << 16):04x}"
+                    replicas = rng.randint(1, 3)
+                    gang = rng.random() < 0.4
+                    cpu = rng.choice(["500m", "1", "2"])
+                    for c in (restricted, full):
+                        c.submit(name, replicas=replicas, cpu=cpu, gang=gang)
+                    live.append((name, replicas))
+                if len(live) > 2 and rng.random() < 0.6:
+                    name, replicas = live.pop(rng.randrange(len(live)))
+                    for c in (restricted, full):
+                        c.complete(name, replicas)
+                restricted.scheduler.run_once(trigger="task")
+                full.scheduler.run_once()
+                assert _wait(
+                    lambda: restricted.binding_map() == full.binding_map()
+                    and restricted.all_placed() and full.all_placed(),
+                    timeout=15.0,
+                ), (
+                    f"round {round_i}: restricted={restricted.binding_map()} "
+                    f"full={full.binding_map()}"
+                )
+            s = restricted.scheduler
+            assert s.restricted_cycles_run == 6
+            # shadow_every=1: every restricted cycle was cross-checked
+            assert s.shadow_checks_run == s.restricted_cycles_run
+            assert s.shadow_divergences == 0
+            # the gauges track the ledger's truth after every cycle
+            resident, schedulable = restricted.cache.ledger_counts()
+            assert resident == len(live)
+            assert schedulable == 0
+        finally:
+            restricted.close()
+            full.close()
+
+    def test_ledger_totals_match_full_sweep_after_churn(self, tmp_path):
+        """The exactness claim behind seeding proportion/DRF from the
+        ledger: after arbitrary churn, the incremental per-queue and
+        per-namespace totals equal a from-scratch sweep of the resident
+        JobInfos — equality, not tolerance."""
+        cluster = IncCluster(tmp_path, "sweep", restricted=True)
+        rng = random.Random(7)
+        live = []
+        try:
+            for round_i in range(5):
+                name = f"s{round_i}"
+                replicas = rng.randint(1, 4)
+                cluster.submit(name, replicas=replicas,
+                               cpu=rng.choice(["1", "2"]),
+                               gang=rng.random() < 0.5)
+                live.append((name, replicas))
+                cluster.scheduler.run_once(trigger="task")
+                if rng.random() < 0.5 and len(live) > 1:
+                    gone, n = live.pop(0)
+                    cluster.complete(gone, n)
+            cache = cluster.cache
+            with cache._mutex:
+                seed = cache.share_ledger.seed()
+                # the sweep the plugins used to do on every open
+                want_q, want_ns = {}, {}
+                for job in cache.jobs.values():
+                    if job.pod_group is None:
+                        continue
+                    alloc = job.allocated.clone()
+                    req = job.allocated.clone()
+                    pending = job.task_status_index.get(TaskStatus.Pending)
+                    for t in (pending or {}).values():
+                        req.add(t.resreq)
+                    qa, qr = want_q.setdefault(
+                        job.queue, (empty_resource(), empty_resource())
+                    )
+                    qa.add(alloc)
+                    qr.add(req)
+                    want_ns.setdefault(
+                        job.namespace, empty_resource()
+                    ).add(alloc)
+            assert set(seed.queues) == set(want_q)
+            for q, (alloc, req) in want_q.items():
+                assert seed.queues[q][0] == alloc, f"queue {q} allocated"
+                assert seed.queues[q][1] == req, f"queue {q} request"
+            assert set(seed.namespaces) == set(want_ns)
+            for ns, alloc in want_ns.items():
+                assert seed.namespaces[ns] == alloc, f"namespace {ns}"
+        finally:
+            cluster.close()
+
+
+class TestDivergencePlant:
+    def test_planted_ledger_corruption_is_flagged_and_heals(self, tmp_path):
+        """A ledger that UNDER-reports schedulable work (the plant drops
+        one uid at read time) makes the restricted session skip a job
+        the shadow full session binds — the cross-check must flag it.
+        Clearing the plant heals the plane: the next cycle binds the
+        skipped job with the cross-check green again."""
+        cluster = IncCluster(tmp_path, "plant", restricted=True)
+        div_before = _counter(
+            "share_ledger_drift_checks_total", result="divergence"
+        )
+        ok_before = _counter("share_ledger_drift_checks_total", result="ok")
+        try:
+            cluster.submit("p0", replicas=2)
+            cluster.cache.share_ledger.plant_divergence(
+                PLANT_DROP_SCHEDULABLE
+            )
+            cluster.scheduler.run_once(trigger="task")
+            assert cluster.scheduler.shadow_divergences == 1
+            assert _counter(
+                "share_ledger_drift_checks_total", result="divergence"
+            ) == div_before + 1
+            # the restricted session never saw p0, so nothing bound
+            assert cluster.binding_map() == {}
+            cluster.cache.share_ledger.clear_plant()
+            cluster.scheduler.run_once(trigger="task")
+            assert _wait(cluster.all_placed, timeout=10.0)
+            assert cluster.scheduler.shadow_divergences == 1
+            assert _counter(
+                "share_ledger_drift_checks_total", result="ok"
+            ) == ok_before + 1
+        finally:
+            cluster.close()
+
+    def test_strict_mode_raises_on_divergence(self, tmp_path):
+        cluster = IncCluster(tmp_path, "strict", restricted=True)
+        cluster.scheduler.shadow_strict = True
+        try:
+            cluster.submit("x0", replicas=1)
+            cluster.cache.share_ledger.plant_divergence(
+                PLANT_DROP_SCHEDULABLE
+            )
+            with pytest.raises(subgraph.ShadowDivergence):
+                cluster.scheduler.run_once(trigger="task")
+        finally:
+            cluster.close()
+
+    def test_inflated_allocated_plant_corrupts_the_seed(self, tmp_path):
+        """The other plant kind: an inflated per-queue allocated total
+        shows up in the seed the sessions consume — and only there (the
+        stored ledger stays exact, so clearing heals it)."""
+        cluster = IncCluster(tmp_path, "inflate", restricted=True)
+        try:
+            cluster.submit("q0", replicas=1)
+            cluster.scheduler.run_once(trigger="task")
+            ledger = cluster.cache.share_ledger
+            clean = ledger.seed()
+            ledger.plant_divergence(PLANT_INFLATE_ALLOCATED)
+            planted = ledger.seed()
+            q = sorted(clean.queues)[0]
+            assert planted.queues[q][0] != clean.queues[q][0]
+            ledger.clear_plant()
+            healed = ledger.seed()
+            assert healed.queues[q][0] == clean.queues[q][0]
+        finally:
+            cluster.close()
+
+
+class TestWakeGate:
+    def test_idle_wake_opens_no_session(self, tmp_path):
+        """A capacity-freed wake with nothing schedulable must cost
+        ZERO sessions: the loop answers ``has_schedulable_pending``
+        from the ledger's O(1) counter and goes back to sleep.  A real
+        arrival afterwards proves the loop is still event-driven, not
+        wedged."""
+        cluster = IncCluster(tmp_path, "wake", period=30.0).start()
+        try:
+            cluster.submit("w0", replicas=2)
+            assert _wait(cluster.all_placed, timeout=10.0)
+            # quiesce: the submit's own micro-cycle(s) finish counting
+            settle = time.monotonic()
+            last = -1
+            while time.monotonic() - settle < 5.0:
+                n = cluster.scheduler.sessions_opened
+                if n != last:
+                    last, settle = n, time.monotonic()
+                elif time.monotonic() - settle >= 0.5:
+                    break
+                time.sleep(0.05)
+            assert not cluster.cache.has_schedulable_pending()
+            opened = cluster.scheduler.sessions_opened
+            # a bound pod departs: capacity freed, a "node" wake — but
+            # nothing is pending, so no session may open on it
+            cluster.kube.delete_pod("ns", "w0-t1")
+            time.sleep(1.0)
+            assert cluster.scheduler.sessions_opened == opened, (
+                "idle capacity-freed wake opened a session"
+            )
+            # the gate only skips EMPTY wakes: a real arrival binds
+            # promptly through the same event plumbing
+            cluster.submit("w1", replicas=1)
+            assert _wait(cluster.all_placed, timeout=10.0)
+            assert cluster.scheduler.sessions_opened > opened
+        finally:
+            cluster.close()
+
+
+class TestIncrementalMetrics:
+    def test_export_shapes_and_label_vocabularies(self):
+        """The four incremental-plane series render in exposition
+        format with their pinned label sets."""
+        metrics.registry.reset()
+        try:
+            metrics.update_resident_jobs(1000000)
+            metrics.update_schedulable_jobs(42)
+            metrics.register_session_scope("full")
+            metrics.register_session_scope("restricted")
+            metrics.register_session_scope("restricted")
+            metrics.register_share_ledger_drift_check("ok")
+            metrics.register_share_ledger_drift_check("divergence")
+            out = metrics.registry.render()
+            assert "volcano_resident_jobs 1000000" in out
+            assert "volcano_schedulable_jobs 42" in out
+            assert 'volcano_session_scope_total{mode="full"} 1' in out
+            assert 'volcano_session_scope_total{mode="restricted"} 2' in out
+            assert (
+                'volcano_share_ledger_drift_checks_total{result="ok"} 1'
+                in out
+            )
+            assert (
+                'volcano_share_ledger_drift_checks_total{result="divergence"} 1'
+                in out
+            )
+        finally:
+            metrics.registry.reset()
+
+    def test_gauges_track_ledger_after_each_cycle(self, tmp_path):
+        cluster = IncCluster(tmp_path, "gauge", restricted=True)
+        scope_before = _counter("session_scope_total", mode="restricted")
+        try:
+            cluster.submit("g0", replicas=2)
+            cluster.submit("g1", replicas=1)
+            cluster.scheduler.run_once(trigger="task")
+            assert _wait(cluster.all_placed, timeout=10.0)
+            resident, schedulable = cluster.cache.ledger_counts()
+            assert resident == 2
+            with metrics.registry._lock:
+                gauges = {
+                    name: v
+                    for (name, _l), v in metrics.registry._gauges.items()
+                }
+            assert gauges.get("volcano_resident_jobs") == resident
+            assert gauges.get("volcano_schedulable_jobs") == schedulable
+            assert _counter(
+                "session_scope_total", mode="restricted"
+            ) == scope_before + 1
+        finally:
+            cluster.close()
+
+
+class TestRestrictedFederation:
+    def test_spillover_and_gang_mix_stays_divergence_free(self, tmp_path):
+        """Restricted sessions on BOTH members of a 2-shard federation,
+        every cycle shadow-checked, while the run exercises the two
+        cross-shard paths at once: a gang that must assemble across
+        shards (home fits one member) and singles that must spill (home
+        capacity consumed).  Everything binds, no partial gang is ever
+        observable, the policy checker passes, and neither member
+        records a single divergence."""
+        from volcano_tpu.federation import (
+            FederatedScheduler,
+            verify_federation,
+        )
+        from volcano_tpu.federation.sharding import home_shard, shard_of_node
+
+        api = APIServer()
+        kube, vc = KubeClient(api), VolcanoClient(api)
+        vc.create_queue(build_queue("default"))
+
+        def nodes_for(shard, count, cpu):
+            out, k = [], 0
+            while len(out) < count:
+                name = f"n{k:03d}"
+                k += 1
+                if shard_of_node(name, 2) == shard:
+                    out.append(build_node(
+                        name, {"cpu": cpu, "memory": "64Gi"},
+                    ))
+            return out
+
+        # shard 1 is nearly full: one 2-cpu node.  shard 0 has room.
+        for node in nodes_for(0, 3, "16") + nodes_for(1, 1, "2"):
+            kube.create_node(node)
+        conf = tmp_path / "fed-conf.yaml"
+        conf.write_text(CONF)
+        feds = [
+            FederatedScheduler(
+                api, f"s{i}", 2, scheduler_conf_path=str(conf),
+                lease_duration=0.8, lease_retry_period=0.04,
+                spill_after=1, gang_broker=True, gang_assemble_after=1,
+            ).start()
+            for i in range(2)
+        ]
+        try:
+            for f in feds:
+                assert f.wait_owned(10.0)
+            assert _wait(
+                lambda: sum(len(f.state.owned()) for f in feds) == 2
+            )
+            for f in feds:
+                f.scheduler.restricted_sessions = True
+                f.scheduler.shadow_every = 1
+
+            # deterministic shard-1-homed names
+            def names_for(shard, count, prefix):
+                out, k = [], 0
+                while len(out) < count:
+                    cand = f"{prefix}{k}"
+                    k += 1
+                    if home_shard("ns", cand, 2) == shard:
+                        out.append(cand)
+                return out
+
+            gname = names_for(1, 1, "gang")[0]
+            vc.create_pod_group(build_pod_group("ns", gname, 3))
+            for i in range(3):
+                kube.create_pod(build_pod(
+                    "ns", f"{gname}-t{i}", "",
+                    {"cpu": "2", "memory": "1Gi"}, group=gname,
+                ))
+            for jname in names_for(1, 2, "spill"):
+                vc.create_pod_group(build_pod_group("ns", jname, 1))
+                kube.create_pod(build_pod(
+                    "ns", f"{jname}-t0", "",
+                    {"cpu": "2", "memory": "1Gi"}, group=jname,
+                ))
+
+            def all_bound():
+                for f in feds:
+                    f.scheduler.run_once(trigger="task")
+                pods = kube.list_pods("ns")
+                gang_bound = sum(
+                    1 for p in pods
+                    if p.spec.node_name
+                    and p.metadata.name.startswith(gname)
+                )
+                assert gang_bound == 0 or gang_bound >= 3, (
+                    f"partial gang observed: {gang_bound}/3 bound"
+                )
+                return all(p.spec.node_name for p in pods)
+
+            assert _wait(all_bound, timeout=30.0, interval=0.05)
+            for f in feds:
+                assert f.scheduler.restricted_cycles_run >= 1
+                assert f.scheduler.shadow_checks_run >= 1
+                assert f.scheduler.shadow_divergences == 0, (
+                    f"{f.identity}: restricted/full divergence under "
+                    "spillover + gang mix"
+                )
+            report = verify_federation(api, 2)
+            assert report["ok"], report["violations"]
+        finally:
+            for f in feds:
+                f.stop()

@@ -26,6 +26,7 @@ from volcano_tpu.api.job_info import get_job_id
 from volcano_tpu.api.queue_info import NamespaceCollection
 from volcano_tpu.apis import core, scheduling, scheme
 from volcano_tpu.cache.interface import Binder, Cache, Evictor, StatusUpdater
+from volcano_tpu.incremental.shares import ShareLedger
 from volcano_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -166,6 +167,11 @@ class SchedulerCache(Cache):
         self.default_priority = default_priority
 
         self.jobs: Dict[str, JobInfo] = {}  # guarded-by: self._mutex
+        #: incremental fair-share ledger + schedulable-work counter,
+        #: maintained by _mark_job (the choke point every job-mutating
+        #: handler passes through) so micro-cycles can gate wakes and
+        #: open restricted sessions without O(resident jobs) sweeps
+        self.share_ledger = ShareLedger()  # guarded-by: self._mutex
         self.nodes: Dict[str, NodeInfo] = {}  # guarded-by: self._mutex
         self.queues: Dict[str, QueueInfo] = {}  # guarded-by: self._mutex
         self.priority_classes: Dict[str, core.PriorityClass] = {}  # guarded-by: self._mutex
@@ -426,14 +432,24 @@ class SchedulerCache(Cache):
         a capacity-freed wake ("node"/"group" triggers): under churn,
         every completion fires one — running a full session per
         departure with nothing pending would double the cycle load for
-        zero bindings."""
+        zero bindings.
+
+        Answered O(1) from the incremental ledger's schedulable-work
+        counter (the set of jobs with a live PodGroup and a non-empty
+        Pending bucket — the exact predicate the old per-wake rescan
+        evaluated over every resident job)."""
         with self._mutex:
-            for job in self.jobs.values():
-                if job.pod_group is None:
-                    continue
-                if job.task_status_index.get(TaskStatus.Pending):
-                    return True
-            return False
+            return self.share_ledger.schedulable_count > 0
+
+    def ledger_counts(self):
+        """(resident, schedulable) job counts from the incremental
+        ledger — the volcano_resident_jobs / volcano_schedulable_jobs
+        gauges."""
+        with self._mutex:
+            return (
+                self.share_ledger.resident_count,
+                self.share_ledger.schedulable_count,
+            )
 
     @staticmethod
     def _classify_pod_update(old_ti: TaskInfo, new_ti: TaskInfo,
@@ -476,6 +492,11 @@ class SchedulerCache(Cache):
         # requires-lock: self._mutex
         self._rev += 1
         self._job_mut_rev[uid] = self._rev
+        # every handler marks AFTER mutating the JobInfo, so the ledger
+        # observes the post-mutation truth here — one diff per event,
+        # never a sweep.  (delete_pod_group marks with pod_group already
+        # None before dropping the job, so the retraction is covered.)
+        self.share_ledger.observe(self.jobs.get(uid), uid)
 
     def _mark_topology(self) -> None:
         # requires-lock: self._mutex
@@ -823,7 +844,21 @@ class SchedulerCache(Cache):
 
     # ---- snapshot (cache.go:712-790) ----
 
-    def snapshot(self) -> ClusterInfo:
+    def snapshot(self, scope: str = "full") -> ClusterInfo:
+        # ``scope`` is the incremental-session seam:
+        #   "full"       — every job (the classic snapshot);
+        #   "restricted" — clone ONLY jobs with schedulable work
+        #                  (O(pending), the restricted micro-cycle);
+        #   "shadow"     — full job set, but ALSO annotated like a
+        #                  restricted snapshot, so one atomic world can
+        #                  feed both the restricted session and its
+        #                  shadow full-session cross-check (computing
+        #                  the restricted set outside the mutex would
+        #                  race cache churn into false divergence).
+        # "restricted"/"shadow" attach ``share_seed`` (the ledger's
+        # cloned totals) and ``restricted_uids`` (the schedulable jobs
+        # that made it into the snapshot).
+        #
         # COMMIT BARRIER: every in-flight pipelined effect (binds,
         # evicts, status writebacks handed off last cycle) must land
         # before new cluster state is read — this is what keeps the
@@ -870,7 +905,15 @@ class SchedulerCache(Cache):
             for name, coll in self.namespace_collections.items():
                 snapshot.namespace_info[name] = coll.snapshot()
 
-            for job in self.jobs.values():
+            if scope == "restricted":
+                job_iter = [
+                    self.jobs[uid]
+                    for uid in sorted(self.share_ledger.schedulable_uids())
+                    if uid in self.jobs
+                ]
+            else:
+                job_iter = self.jobs.values()
+            for job in job_iter:
                 # No scheduling spec → not schedulable (cache.go:765-770).
                 if job.pod_group is None:
                     continue
@@ -901,6 +944,15 @@ class SchedulerCache(Cache):
                 dirty_nodes=set(self._dirty_nodes),
                 dirty_nodes_full=set(self._dirty_nodes_full),
             )
+            if scope != "full":
+                snapshot.share_seed = self.share_ledger.seed()
+                if scope == "restricted":
+                    snapshot.restricted_uids = set(snapshot.jobs)
+                else:
+                    snapshot.restricted_uids = (
+                        self.share_ledger.schedulable_uids()
+                        & set(snapshot.jobs)
+                    )
             if self.snapshot_reuse:
                 self._clone_gen += 1
                 snapshot.clone_gen = self._clone_gen

@@ -398,10 +398,12 @@ def _describe_podgroup(vc: VolcanoClient, args, out) -> int:
 def _shards(vc: VolcanoClient, args, out) -> int:
     """Render the live shard map: per-shard lease holders, the member
     heartbeats, and each member's published stats (nodes owned,
-    spillover counters, rebalances).  Reads ONLY the shard-map
-    ConfigMap through the API surface, so the output is byte-identical
-    over the in-process backend and ``--bus`` for the same store
-    state."""
+    spillover counters, rebalances, capacity-sketch freshness and the
+    sketch-vs-truth verification split).  Reads ONLY the shard-map
+    ConfigMap through the API surface — sketch age is computed against
+    the newest renew tick ON the map, never a call-time clock — so the
+    output is byte-identical over the in-process backend and ``--bus``
+    for the same store state."""
     from volcano_tpu.federation import read_shard_map
 
     rec = read_shard_map(vc.api)
@@ -464,6 +466,41 @@ def _shards(vc: VolcanoClient, args, out) -> int:
                     f"{k}={gang[k]}" for k in sorted(gang)
                 ) or "<none>"
                 print(f"  {'':<22}gang-assembly: {gang_txt}", file=out)
+            # the free-capacity sketch rides the lease heartbeat, so
+            # its age is the member's heartbeat measured against the
+            # NEWEST renew tick on the map (stored fields only — a
+            # call-time clock would break cross-backend byte-identity);
+            # a sketch older than the member's lease TTL is the signal
+            # foreign solicitation is flying blind on this member
+            sketch = s.get("sketch")
+            if sketch is not None:
+                latest = max(
+                    [e.get("renewTime", 0)
+                     for e in rec.get("shards", {}).values()]
+                    + [m.get("heartbeat", 0) for m in members.values()]
+                    + [0]
+                )
+                m = members.get(ident, {})
+                hb = m.get("heartbeat", 0)
+                ttl = m.get("leaseDurationSeconds", 0)
+                age = max(0.0, float(latest) - float(hb))
+                fresh = "fresh" if age <= ttl else "STALE"
+                print(
+                    f"  {'':<22}sketch: slots={sketch.get('freeSlots', 0)} "
+                    f"topNodes={len(sketch.get('topNodes') or ())} "
+                    f"age={age:g}s/ttl={ttl:g}s ({fresh})",
+                    file=out,
+                )
+            # sketch-vs-truth: how often a sketch-solicited candidate
+            # survived (verified) or failed (stale) the bind-time
+            # per-node truth check — the observable cost of trading
+            # the O(cluster) mirror for O(shards·K) sketches
+            checks = s.get("sketchChecks")
+            if checks is not None:
+                checks_txt = " ".join(
+                    f"{k}={checks[k]}" for k in sorted(checks)
+                ) or "<none>"
+                print(f"  {'':<22}sketch-checks: {checks_txt}", file=out)
     return 0
 
 

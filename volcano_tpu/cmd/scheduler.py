@@ -43,6 +43,7 @@ class SchedulerDaemon(BaseDaemon):
         pipelined_commit: bool = False,
         micro_cycles: bool = False,
         micro_debounce_ms: float = 5.0,
+        restricted_sessions: bool = False,
         shards: int = 0,
         shard_identity: str = "",
         shard_lease_duration: float = 2.0,
@@ -84,6 +85,7 @@ class SchedulerDaemon(BaseDaemon):
                 gang_broker=gang_broker,
                 kill_mode="exit",  # shard.kill hard-exits the process
                 autoscale=shard_autoscale,
+                restricted_sessions=restricted_sessions,
             )
             self.elector = None
             self.cache = self.federation.cache
@@ -107,6 +109,7 @@ class SchedulerDaemon(BaseDaemon):
             cycle_deadline_ms=cycle_deadline_ms,
             micro_cycles=micro_cycles,
             micro_debounce_ms=micro_debounce_ms,
+            restricted_sessions=restricted_sessions,
         )
 
     def _on_start(self) -> None:
@@ -220,6 +223,17 @@ def main(argv=None) -> int:
         help="event-storm coalescing window: after the first watch "
         "event wakes the loop, wait this long so the rest of the burst "
         "lands in the same micro-cycle",
+    )
+    parser.add_argument(
+        "--restricted-sessions", action="store_true",
+        help="open micro-cycle sessions over only the jobs with "
+        "schedulable work (plus the share ledger's seeded fair-share "
+        "state) instead of every resident job — O(pending) session "
+        "cost on clusters dominated by Running jobs.  Soundness is "
+        "cross-checked by sampled shadow full sessions "
+        "(volcano_share_ledger_drift_checks_total); full cycles and "
+        "victim-selecting actions always see the full job set.  "
+        "Requires --micro-cycles",
     )
     parser.add_argument(
         "--shards", type=int, default=0,
@@ -388,6 +402,7 @@ def main(argv=None) -> int:
             pipelined_commit=args.pipelined_commit,
             micro_cycles=args.micro_cycles,
             micro_debounce_ms=args.micro_debounce_ms,
+            restricted_sessions=args.restricted_sessions,
             shards=args.shards,
             shard_identity=args.shard_identity,
             shard_lease_duration=args.shard_lease_duration,

@@ -19,9 +19,16 @@ Pieces:
   expiry / release-on-join over one CAS-updated ConfigMap.
 * :mod:`filter` — ``ShardInformerFilter``: shard-filters informer
   deliveries so cache and pack stay O(nodes/N), with relist-on-acquire
-  when ownership moves; also feeds the foreign-node spillover ledger.
+  when ownership moves; keeps an OWNED-slice capacity ledger and
+  publishes it as the free-capacity sketch on the lease heartbeat.
+* :mod:`sketches` — ``SketchSolicitor``: the per-shard free-capacity
+  sketches on the lease map are the ONLY foreign state a member holds
+  (no O(cluster) mirror); candidates solicited from them are verified
+  against per-node store truth at CAS/txn time, so a stale sketch only
+  PRUNES, never overcommits.
 * :mod:`spillover` — ``SpilloverController``: home-shard-stuck tasks
-  CAS-bind onto foreign-shard nodes with bounded retry on conflict.
+  CAS-bind onto sketch-solicited foreign-shard nodes with bounded
+  retry on conflict.
 * :mod:`broker` — ``GangBroker``: cross-shard gang assembly — a
   home-owned gang below ``minMember`` solicits foreign capacity
   (sketch-gated, O(shards)) and commits a full-gang placement via one
@@ -57,6 +64,7 @@ from volcano_tpu.federation.broker import (  # noqa: F401
     GangBroker,
     solicitable_shards,
 )
+from volcano_tpu.federation.sketches import SketchSolicitor  # noqa: F401
 from volcano_tpu.federation.autoscale import (  # noqa: F401
     AutoscalePolicy,
     ShardAutoscaler,

@@ -20,14 +20,20 @@ spillover pass — never concurrently with a session):
 2. **Solicit**: foreign shards are considered only when the
    free-capacity *sketch* their holder piggybacks on the lease-map
    heartbeat could plausibly host a claim (``solicitable_shards``) —
-   solicitation is O(shards), not O(cluster).
+   solicitation is O(shards), not O(cluster) — and the candidate
+   nodes themselves are materialized from the surviving sketches'
+   ``topNodes`` entries (``SketchSolicitor.foreign_entries``), the
+   ONLY foreign state a member holds (federation/sketches.py).
 3. **Assemble**: ``ShardInformerFilter.plan_gang_assembly`` builds a
-   full-gang placement — home nodes fill first, foreign claims fill
-   the remainder, honoring selectors/taints via the same predicate
-   helpers the spillover candidates use, with claims debited inside
-   the plan so the assembly cannot overcommit a node against itself.
-4. **Commit**: every claim is re-verified against store truth (fresh
-   resourceVersions) and the whole assembly ships as one
+   full-gang placement — home nodes fill first (from the owned-slice
+   ledger), sketch-solicited foreign claims fill the remainder,
+   honoring selectors/taints via the same predicate helpers the
+   spillover candidates use, with claims debited inside the plan so
+   the assembly cannot overcommit a node against itself.
+4. **Commit**: foreign nodes are checked against per-node store truth
+   (a stale sketch PRUNES, never decides), every claim is re-verified
+   against store truth (fresh resourceVersions) and the whole
+   assembly ships as one
    ``txn_commit``.  On conflict the per-item results say which claim
    went stale; the assembly is discarded WHOLE — the host gang loop's
    discard-until-stable cascade semantics, transaction-sized — and
@@ -53,8 +59,8 @@ from typing import Callable, Dict, List, Optional, Set
 
 from volcano_tpu.client.apiserver import ApiError
 from volcano_tpu.federation.filter import ShardInformerFilter
-from volcano_tpu.federation.leases import read_shard_map
 from volcano_tpu.federation.sharding import ShardState
+from volcano_tpu.federation.sketches import SketchSolicitor, UNREAD
 from volcano_tpu.metrics import metrics
 from volcano_tpu.utils.logging import get_logger
 
@@ -62,10 +68,6 @@ log = get_logger(__name__)
 
 #: conflict backoff ceiling, in post-cycle passes skipped
 _MAX_BACKOFF = 8
-
-#: sentinel: the shard map has not been read yet this pass (None is a
-#: meaningful value — "no map / read failed, solicit unfiltered")
-_UNREAD = object()
 
 
 def solicitable_shards(
@@ -121,11 +123,16 @@ class GangBroker:
         assemble_after: int = 2,
         max_gangs_per_cycle: int = 8,
         kill_hook: Optional[Callable[[], None]] = None,
+        sketches: SketchSolicitor = None,
     ):
         self.cache = cache
         self.state = state
         self.filter = filter_
         self.api = api
+        #: foreign-candidate source: the other members' published
+        #: capacity sketches (the runtime shares one solicitor with the
+        #: spillover controller so the verified/stale counters aggregate)
+        self.sketches = sketches or SketchSolicitor(api, state)
         self.assemble_after = assemble_after
         self.max_gangs_per_cycle = max_gangs_per_cycle
         self.kill_hook = kill_hook
@@ -176,7 +183,7 @@ class GangBroker:
         live = set()
         committed = 0
         budget = self.max_gangs_per_cycle
-        rec = _UNREAD
+        rec = UNREAD
         for entry in view:
             if self._halted:
                 # the kill hook fired mid-pass (crash mode): a SIGKILLed
@@ -197,14 +204,12 @@ class GangBroker:
             if skip > 0:
                 self._backoff[jid] = skip - 1
                 continue
-            if rec is _UNREAD:
+            if rec is UNREAD:
                 # one shard-map read per PASS, not per gang — the map
-                # only changes on lease ticks, and each gang's plan
-                # re-verifies claims against store truth anyway
-                try:
-                    rec = read_shard_map(self.api)
-                except ApiError:
-                    rec = None  # solicit unfiltered; per-node checks gate
+                # only changes on lease ticks, and each gang's claims
+                # are re-verified against store truth anyway.  None
+                # means no foreign state: home-only plans this pass.
+                rec = self.sketches.read_map()
             budget -= 1
             if self._assemble_one(entry, rec):
                 committed += 1
@@ -278,7 +283,7 @@ class GangBroker:
             self._count("infeasible")
             self._defer(jid)
             return False
-        shard_ok = None
+        foreign: List[list] = []
         if rec is not None:
             with obs.span("gang:solicit", cat="federation"):
                 ok = solicitable_shards(
@@ -287,9 +292,16 @@ class GangBroker:
                     min(t.resreq.get("memory") for t in tasks),
                     self.state.owned(),
                 )
-            shard_ok = ok.__contains__
+                # materialize candidates only for shards whose aggregate
+                # sketch could plausibly host a claim — the per-node
+                # topNodes entries of everything else stay unread
+                foreign = self.sketches.foreign_entries(
+                    rec, shard_ok=ok.__contains__
+                )
         with obs.span("gang:plan", cat="federation"):
-            plan = self.filter.plan_gang_assembly(tasks, shard_ok=shard_ok)
+            plan = self.filter.plan_gang_assembly(
+                tasks, foreign_entries=foreign
+            )
         if len(plan) < need:
             # the cluster (as this ledger sees it) cannot host the
             # minimum — the honest Pending outcome, counted so operator
@@ -309,6 +321,15 @@ class GangBroker:
             if self.kill_hook is not None:
                 self.kill_hook()
             return False
+        # sketch-solicited foreign nodes: check store truth before the
+        # transaction — a vanished/cordoned node is the sketch's
+        # staleness window showing (a pruning event); discard the
+        # assembly whole and retry against fresh truth
+        for host in {h for _t, h in plan if not self.state.owns_node(h)}:
+            if not self.sketches.verify_node(host):
+                self._count("conflict")
+                self._defer(jid)
+                return False
         # re-verify every claim against store truth and stamp the
         # resourceVersions the transaction will insist on
         binds: List[dict] = []

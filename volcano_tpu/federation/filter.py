@@ -1,4 +1,4 @@
-"""Shard-filtered informer delivery + the foreign-node spillover ledger.
+"""Shard-filtered informer delivery + the owned-slice capacity ledger.
 
 The filter sits between the informer feed and the ``SchedulerCache``
 (``SchedulerCache.set_informer_sink``): it receives every watch event,
@@ -18,19 +18,21 @@ Forwarding rules:
 * **queues / priority classes / PVCs** — global, always forwarded.
 
 Ownership changes replay state instead of resubscribing: on acquire,
-nodes come from the ledger (every node object is retained — they are
-small and the spillover ledger needs them anyway) and pods/podgroups
-are relisted through the client; on release, the now-foreign slice is
-delivered to the cache as deletions.  A short tombstone set papers over
-the classic list-vs-delete race during a relist.
+nodes/pods/podgroups are relisted through the client; on release, the
+now-foreign slice is delivered to the cache as deletions.  A short
+tombstone set papers over the classic list-vs-delete race during a
+relist.
 
-The ledger half tracks, for every node in the cluster, the raw node
-object plus the summed requests of active bound pods — the capacity
-view ``SpilloverController`` picks foreign CAS-bind candidates from.
-It is deliberately cluster-sized but entry-light (one small record per
-node, one (node, resreq) pair per bound pod); the heavy structures —
-NodeInfo graphs, snapshots, device planes — are what sharding keeps at
-O(nodes/N).
+The ledger half tracks the OWNED nodes' raw objects plus the summed
+requests of active bound pods — the capacity view behind the
+free-capacity sketch this member piggybacks on its lease heartbeat
+(:meth:`capacity_sketch`) and the home tier of gang-assembly plans.
+Earlier builds kept this ledger cluster-wide so spillover could pick
+foreign candidates locally; that mirror — the last O(cluster)
+structure per member — is gone.  Foreign capacity now comes
+exclusively from the other members' published sketches
+(federation/sketches.py), verified against per-node store truth at
+bind time, so every per-member structure is O(nodes/N).
 """
 
 from __future__ import annotations
@@ -93,12 +95,13 @@ class ShardInformerFilter:
         #: None leaves acquire to the node ledger only (unit tests)
         self.lister = lister
         self._lock = threading.Lock()
-        # ---- ledger: every node + bound-pod accounting ----
+        # ---- ledger: OWNED nodes + their bound-pod accounting ----
         self._nodes: Dict[str, core.Node] = {}  # guarded-by: self._lock
         self._node_alloc: Dict[str, Resource] = {}  # guarded-by: self._lock
         self._node_used: Dict[str, Resource] = {}  # guarded-by: self._lock
         self._node_ntasks: Dict[str, int] = {}  # guarded-by: self._lock
-        #: pod key → (node_name, resreq) for ACTIVE bound pods
+        #: pod key → (node_name, resreq) for ACTIVE pods bound to owned
+        #: nodes
         self._pod_loc: Dict[str, Tuple[str, Resource]] = {}  # guarded-by: self._lock
         # ---- forwarding bookkeeping ----
         self._fwd_nodes: set = set()  # guarded-by: self._lock
@@ -153,7 +156,10 @@ class ShardInformerFilter:
     def _ledger_pod(self, pod: Optional[core.Pod]) -> None:
         # requires-lock: self._lock
         """Reconcile one pod's contribution to the used accounting (pass
-        None-shaped deletes via _ledger_unpod)."""
+        None-shaped deletes via _ledger_unpod).  Only pods bound to
+        OWNED nodes are tracked — the ledger is the owned slice; a pod
+        on a foreign node is the foreign holder's accounting problem
+        (its sketch reflects it)."""
         key = _pod_key(pod)
         prev = self._pod_loc.pop(key, None)
         if prev is not None:
@@ -163,7 +169,7 @@ class ShardInformerFilter:
                 self._node_ntasks[node] = max(
                     self._node_ntasks.get(node, 1) - 1, 0
                 )
-        if _pod_active(pod):
+        if _pod_active(pod) and self.state.owns_node(pod.spec.node_name):
             req = _pod_resreq(pod)
             node = pod.spec.node_name
             self._pod_loc[key] = (node, req)
@@ -187,9 +193,9 @@ class ShardInformerFilter:
         name = node.metadata.name
         with self._lock:
             self._tombstones.pop(name, None)  # fresh truth supersedes
-            self._ledger_node(node)
             fwd = self.state.owns_node(name)
             if fwd:
+                self._ledger_node(node)
                 self._fwd_nodes.add(name)
                 self._owned_gauge()
         if fwd:
@@ -199,11 +205,12 @@ class ShardInformerFilter:
         name = node.metadata.name
         with self._lock:
             self._tombstones.pop(name, None)
-            self._ledger_node(node)
             fwd = self.state.owns_node(name)
-            if fwd and name not in self._fwd_nodes:
-                self._fwd_nodes.add(name)
-                self._owned_gauge()
+            if fwd:
+                self._ledger_node(node)
+                if name not in self._fwd_nodes:
+                    self._fwd_nodes.add(name)
+                    self._owned_gauge()
         if fwd:
             self.cache.update_node(old, node)
 
@@ -349,26 +356,12 @@ class ShardInformerFilter:
     # ---- ownership transitions (lease-manager thread) ----
 
     def on_acquire(self, shard: int) -> None:
-        """Replay the acquired slice into the cache: nodes from the
-        ledger (their ADDED events were dropped while foreign), then a
-        pod + podgroup relist through the client.  ``ShardState`` has
-        already flipped, so live events for the shard forward
-        concurrently; the forwarded sets make replay-vs-event delivery
-        exactly-once."""
-        with self._lock:
-            to_add = [
-                node for name, node in self._nodes.items()
-                if shard_of_node(name, self.state.n_shards) == shard
-                and name not in self._fwd_nodes
-            ]
-            for node in to_add:
-                self._fwd_nodes.add(node.metadata.name)
-            if to_add:
-                self._owned_gauge()
-        for node in to_add:
-            # emits "topology" — the planes must rebuild over the grown
-            # node set anyway, so the event loop routes to a full cycle
-            self.cache.add_node(node)
+        """Replay the acquired slice into the cache via a node + pod +
+        podgroup relist through the client (the slice's ADDED events
+        were dropped while foreign, and nothing is mirrored locally —
+        the ledger is owned-only).  ``ShardState`` has already flipped,
+        so live events for the shard forward concurrently; the
+        forwarded sets make replay-vs-event delivery exactly-once."""
         self._relist_objects(shard)
 
     def _relist_objects(self, shard: int) -> None:
@@ -475,6 +468,13 @@ class ShardInformerFilter:
             ]
             for node in drop_nodes:
                 self._fwd_nodes.discard(node.metadata.name)
+                self._ledger_drop_node(node.metadata.name)
+            # shed the released slice's pod accounting with it — the
+            # new holder's relist rebuilds it there; keeping the
+            # entries here would leak one record per pod forever
+            for key, (node_name, _req) in list(self._pod_loc.items()):
+                if not self.state.owns_node(node_name):
+                    del self._pod_loc[key]
             drop_pods = [
                 pod for key, pod in list(self._fwd_pods.items())
                 if not self._pod_relevant(pod)
@@ -505,11 +505,12 @@ class ShardInformerFilter:
     def _capacity_entries(self) -> List[list]:
         # requires-lock: self._lock
         """``[free_cpu, name, node, free, slots]`` for every
-        schedulable ledger node with pod slots left — the ONE copy of
-        the node-eligibility + free-capacity math shared by
-        ``spill_candidates`` and ``plan_gang_assembly``, so a fix to
-        either's view of "can this node take a claim" cannot drift
-        from the other's."""
+        schedulable OWNED ledger node with pod slots left — the ONE
+        copy of the node-eligibility + free-capacity math shared by
+        ``capacity_sketch`` and ``plan_gang_assembly``'s home tier, so
+        a fix to either's view of "can this node take a claim" cannot
+        drift from the other's.  (Foreign entries in the same shape are
+        built from published sketches by federation/sketches.py.)"""
         out = []
         for name, node in self._nodes.items():
             if node.spec.unschedulable:
@@ -541,22 +542,6 @@ class ShardInformerFilter:
             and putil.pod_tolerates_node_taints(pod, node)
         )
 
-    def spill_candidates(self, task, limit: int = 8) -> List[str]:
-        """Foreign nodes that could host ``task`` right now, by the
-        ledger's capacity view: resource fit against allocatable minus
-        summed active requests, node schedulable, selector + taints
-        hold.  Most-free-CPU first (a deterministic spread that avoids
-        dogpiling one node), capped at ``limit``."""
-        out = []
-        with self._lock:
-            for free_cpu, name, node, free, _slots in self._capacity_entries():
-                if self.state.owns_node(name):
-                    continue
-                if self._task_fits(task, node, free):
-                    out.append((free_cpu, name))
-        out.sort(key=lambda t: (-t[0], t[1]))
-        return [name for _free, name in out[:limit]]
-
     def note_spill_bind(self, pod: core.Pod) -> None:
         """Account a successful spillover bind immediately (the watch
         echo also lands later; _ledger_pod reconciles, so this is not
@@ -567,26 +552,37 @@ class ShardInformerFilter:
 
     # ---- gang-assembly support (federation/broker.py) ----
 
+    #: sketch topNodes depth: enough freest nodes per shard that a
+    #: burst of spills/claims has alternatives after in-pass debits,
+    #: small enough that N shards' sketches stay a trivial map record
+    _SKETCH_TOP_NODES = 8
+
     def capacity_sketch(self) -> dict:
-        """The owned slice's free capacity, summarized to a handful of
-        numbers — piggybacked on the lease-map heartbeat (the member
-        stats blob) so a foreign gang broker can decide whether this
-        shard's slice could plausibly host a claim WITHOUT walking an
-        O(cluster) ledger for shards that obviously cannot.  This is
-        the first bite of the ledger-trim roadmap item: solicitation is
-        O(shards), though the ledger itself is still cluster-sized.
+        """The owned slice's free capacity, summarized — piggybacked on
+        the lease-map heartbeat (the member stats blob).  Since the
+        foreign-node mirror was deleted, this is the ONLY foreign state
+        in the federation: spillover and the gang broker solicit
+        candidates from these sketches (federation/sketches.py) and
+        verify per-node store truth at CAS/txn time, so O(cluster)
+        capacity knowledge lives nowhere — each member publishes
+        O(nodes/N) and reads O(shards · K).
 
         Fields (cpu in milli, memory in bytes, like Resource):
         ``freeCpuMilli``/``freeMemory`` — summed free capacity across
         schedulable owned nodes with pod slots left; ``maxFreeCpuMilli``
         /``maxFreeMemory`` — the single best node (a gang TASK needs
         one node that fits it, not an aggregate); ``freeSlots`` — owned
-        nodes that can still take a pod."""
+        nodes that can still take a pod; ``topNodes`` — the K freest
+        owned nodes by free cpu, each carrying the free view plus the
+        predicate inputs (labels/taints/unschedulable) a foreign
+        member needs to run the same selector/taint checks it runs on
+        its own candidates."""
         free_cpu = free_mem = 0.0
         max_cpu = max_mem = 0.0
         slots = 0
+        entries = []
         with self._lock:
-            for cpu, name, _node, free, _slots in self._capacity_entries():
+            for cpu, name, node, free, nslots in self._capacity_entries():
                 if name not in self._fwd_nodes:
                     continue  # the sketch advertises the OWNED slice
                 c = max(cpu, 0.0)
@@ -596,42 +592,55 @@ class ShardInformerFilter:
                 max_cpu = max(max_cpu, c)
                 max_mem = max(max_mem, m)
                 slots += 1
+                entries.append((c, m, name, node, nslots))
+        entries.sort(key=lambda e: (-e[0], e[2]))
+        top = [
+            {
+                "name": name,
+                "freeCpuMilli": round(c),
+                "freeMemory": round(m),
+                "slots": nslots,
+                "labels": dict(node.metadata.labels or {}),
+                "taints": [
+                    {"key": t.key, "value": t.value, "effect": t.effect}
+                    for t in (node.spec.taints or [])
+                ],
+                "unschedulable": bool(node.spec.unschedulable),
+            }
+            for c, m, name, node, nslots in entries[: self._SKETCH_TOP_NODES]
+        ]
         return {
             "freeCpuMilli": round(free_cpu),
             "freeMemory": round(free_mem),
             "maxFreeCpuMilli": round(max_cpu),
             "maxFreeMemory": round(max_mem),
             "freeSlots": slots,
+            "topNodes": top,
         }
 
-    def plan_gang_assembly(self, tasks, shard_ok=None) -> List[Tuple[object, str]]:
-        """Greedy full-gang placement plan over the ledger's capacity
-        view: HOME-owned nodes fill first (the home cycle only refused
-        because the gang could not complete, not because home had no
-        room), foreign claims fill the remainder.  ``shard_ok`` is an
-        optional predicate ``shard_id -> bool`` gating which FOREIGN
-        shards are solicited (the broker derives it from the per-shard
-        capacity sketches on the lease map).  Claims are accounted
-        within the plan — each placement debits its node's free view —
-        so one assembly can never overcommit a node against itself.
+    def plan_gang_assembly(
+        self, tasks, foreign_entries: Optional[List[list]] = None,
+    ) -> List[Tuple[object, str]]:
+        """Greedy full-gang placement plan: HOME-owned nodes fill first
+        (the home cycle only refused because the gang could not
+        complete, not because home had no room), foreign claims fill
+        the remainder from ``foreign_entries`` — capacity entries the
+        broker builds out of the other members' published sketches
+        (``SketchSolicitor.foreign_entries``); None/empty means
+        home-only.  Claims are accounted within the plan — each
+        placement debits its node's free view (foreign entries are
+        per-call copies, so the debits are plan-local) — so one
+        assembly can never overcommit a node against itself.
 
         Returns ``[(task, hostname)]`` for every task it could place,
         in task order; the caller judges sufficiency (and re-verifies
         everything against store truth via the ``txn_commit``
         preconditions before anything binds)."""
-        home: List[list] = []
-        foreign: List[list] = []
         with self._lock:
-            for entry in self._capacity_entries():
-                name = entry[1]
-                owned = self.state.owns_node(name)
-                if not owned and shard_ok is not None and not shard_ok(
-                    shard_of_node(name, self.state.n_shards)
-                ):
-                    continue
-                (home if owned else foreign).append(entry)
-        # most-free-cpu first within each tier (the spill_candidates
-        # spread), name as the deterministic tie-break
+            home = self._capacity_entries()
+        foreign = list(foreign_entries or [])
+        # most-free-cpu first within each tier (the deterministic
+        # spread), name as the tie-break
         home.sort(key=lambda e: (-e[0], e[1]))
         foreign.sort(key=lambda e: (-e[0], e[1]))
         candidates = home + foreign

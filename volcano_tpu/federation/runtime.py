@@ -31,6 +31,7 @@ from volcano_tpu.federation.broker import GangBroker
 from volcano_tpu.federation.filter import ShardInformerFilter
 from volcano_tpu.federation.leases import ShardLeaseManager
 from volcano_tpu.federation.sharding import ShardState
+from volcano_tpu.federation.sketches import SketchSolicitor
 from volcano_tpu.federation.spillover import SpilloverController
 from volcano_tpu.scheduler.scheduler import Scheduler
 from volcano_tpu.utils.logging import get_logger
@@ -67,6 +68,9 @@ class FederatedScheduler:
         gang_assemble_after: int = 2,
         kill_mode: str = "crash",
         autoscale=None,
+        restricted_sessions: bool = False,
+        shadow_every: int = 16,
+        shadow_strict: bool = False,
     ):
         self.api = api
         self.identity = identity
@@ -81,9 +85,14 @@ class FederatedScheduler:
         self.state = ShardState(n_shards)
         self.filter = ShardInformerFilter(self.cache, self.state, lister=api)
         self.cache.set_informer_sink(self.filter)
+        #: ONE solicitor shared by both cross-shard bind paths, so the
+        #: verified/stale counters published on the stats blob (and
+        #: rendered by ``vtctl shards``) aggregate the whole member
+        self.sketches = SketchSolicitor(api, self.state)
         self.spillover = SpilloverController(
             self.cache, self.state, self.filter, api,
             spill_after=spill_after,
+            sketches=self.sketches,
         )
         #: cross-shard gang assembly (txn_commit); ``--gang-broker off``
         #: keeps the PR 9 refusal semantics — a below-minMember gang
@@ -92,6 +101,7 @@ class FederatedScheduler:
             self.cache, self.state, self.filter, api,
             assemble_after=gang_assemble_after,
             kill_hook=self._hard_kill,
+            sketches=self.sketches,
         ) if gang_broker else None
         #: SLO-driven shard autoscaling (federation/autoscale.py):
         #: ``autoscale`` is an AutoscalePolicy (or True for defaults).
@@ -129,6 +139,9 @@ class FederatedScheduler:
             period=period,
             micro_cycles=micro_cycles,
             micro_debounce_ms=micro_debounce_ms,
+            restricted_sessions=restricted_sessions,
+            shadow_every=shadow_every,
+            shadow_strict=shadow_strict,
         )
         self.scheduler.post_cycle = self._post_cycle
         self._owned_event = threading.Event()
@@ -180,6 +193,7 @@ class FederatedScheduler:
             "spillover": self.spillover.counters(),
             "rebalances": self.leases.rebalances,
             "sketch": self.filter.capacity_sketch(),
+            "sketchChecks": self.sketches.counters(),
         }
         if self.metrics_addr:
             out["metricsAddr"] = self.metrics_addr

@@ -38,6 +38,7 @@ from typing import Dict
 from volcano_tpu.client.apiserver import ApiError, ConflictError
 from volcano_tpu.federation.filter import ShardInformerFilter
 from volcano_tpu.federation.sharding import ShardState
+from volcano_tpu.federation.sketches import SketchSolicitor, UNREAD
 from volcano_tpu.metrics import metrics
 from volcano_tpu.utils.logging import get_logger
 
@@ -90,11 +91,16 @@ class SpilloverController:
         spill_after: int = 2,
         max_per_cycle: int = 128,
         candidate_retries: int = 3,
+        sketches: SketchSolicitor = None,
     ):
         self.cache = cache
         self.state = state
         self.filter = filter_
         self.api = api
+        #: foreign-candidate source: the other members' published
+        #: capacity sketches (the runtime shares one solicitor with the
+        #: gang broker so the verified/stale counters aggregate)
+        self.sketches = sketches or SketchSolicitor(api, state)
         self.spill_after = spill_after
         self.max_per_cycle = max_per_cycle
         self.candidate_retries = candidate_retries
@@ -146,32 +152,45 @@ class SpilloverController:
             if key not in live:
                 del self._seen[key]
         spilled = 0
+        rec = UNREAD
         for task in eligible[: self.max_per_cycle]:
-            if self._spill_one(task):
+            if rec is UNREAD:
+                # one shard-map read per PASS with eligible work, not
+                # per task — the sketches only change on lease ticks,
+                # and per-node truth is re-verified at bind time anyway
+                rec = self.sketches.read_map()
+            if self._spill_one(task, rec):
                 spilled += 1
                 self._seen.pop(f"{task.namespace}/{task.name}", None)
         return spilled
 
-    def _spill_one(self, task) -> bool:
+    def _spill_one(self, task, rec=UNREAD) -> bool:
         from volcano_tpu import obs
 
+        if rec is UNREAD:
+            rec = self.sketches.read_map()
         if not obs.enabled():
-            return self._spill_one_inner(task)
+            return self._spill_one_inner(task, rec)
         with obs.span(
             "spillover:cas_bind", cat="federation",
             trace_id=obs.trace_id_for_pod(task.namespace, task.name),
             args={"pod": f"{task.namespace}/{task.name}"},
         ):
-            return self._spill_one_inner(task)
+            return self._spill_one_inner(task, rec)
 
-    def _spill_one_inner(self, task) -> bool:
-        candidates = self.filter.spill_candidates(
-            task, limit=self.candidate_retries
+    def _spill_one_inner(self, task, rec) -> bool:
+        candidates = self.sketches.spill_candidates(
+            task, rec, limit=self.candidate_retries
         )
         if not candidates:
             self._count("no-fit")
             return False
         for hostname in candidates:
+            # sketch-solicited: check the node's store truth before the
+            # CAS — a vanished/cordoned node is the sketch's staleness
+            # window showing (a pruning event), try the next candidate
+            if not self.sketches.verify_node(hostname):
+                continue
             try:
                 pre = self.api.get("Pod", task.namespace, task.name)
                 if pre is None or pre.spec.node_name:

@@ -23,16 +23,28 @@ log = get_logger(__name__)
 
 
 def open_session(
-    cache: Cache, tiers: List[Tier], configurations: List[Configuration]
+    cache: Cache, tiers: List[Tier], configurations: List[Configuration],
+    snapshot=None, job_uids=None,
 ) -> Session:
-    """framework.go:30-53 + session.go openSession:72-139."""
+    """framework.go:30-53 + session.go openSession:72-139.
+
+    ``snapshot``/``job_uids`` are the incremental-session seams
+    (volcano_tpu/incremental/subgraph.py): a pre-taken snapshot skips
+    the cache call (so a restricted session and its shadow cross-check
+    derive from ONE atomic world), and ``job_uids`` restricts the
+    session's job view to that subset — carrying the snapshot's share
+    seed into ``ssn.share_seed`` so proportion/DRF can seed the totals
+    the excluded jobs would have contributed.  Restricted sessions run
+    with ``pack_epoch=None``: the cycle-persistent warm packer's
+    registry must only ever consume full worlds."""
     rec = trace.get_recorder()
     open_start = time.perf_counter()
     ssn = Session(cache)
     ssn.tiers = tiers
     ssn.configurations = configurations
 
-    snapshot = cache.snapshot()
+    if snapshot is None:
+        snapshot = cache.snapshot()
     ssn.jobs = snapshot.jobs
     ssn.nodes = snapshot.nodes
     ssn.queues = snapshot.queues
@@ -40,6 +52,14 @@ def open_session(
     ssn.pvcs = snapshot.pvcs
     ssn.pack_epoch = getattr(snapshot, "pack_epoch", None)
     ssn.clone_gen = getattr(snapshot, "clone_gen", 0)
+    if job_uids is not None:
+        ssn.jobs = {
+            uid: snapshot.jobs[uid]
+            for uid in job_uids
+            if uid in snapshot.jobs
+        }
+        ssn.share_seed = getattr(snapshot, "share_seed", None)
+        ssn.pack_epoch = None
 
     # Instantiate plugins listed in tiers (framework.go:37-45).
     for tier in tiers:

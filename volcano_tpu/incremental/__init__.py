@@ -1,0 +1,34 @@
+"""Incremental-session plane — cycle-persistent scheduling state.
+
+Micro-cycles used to pay O(TOTAL resident jobs) per wake: a full
+snapshot, a full plugin-open sweep (proportion/DRF recompute every
+queue's share from every JobInfo), and a full job-updater pass — even
+when only a handful of jobs had schedulable work.  This package makes
+micro-cycle cost proportional to **schedulable work, not residency**:
+
+* :mod:`shares` — ``ShareLedger``: per-queue / per-namespace
+  allocated+request totals maintained incrementally by the SAME cache
+  mutation choke point (``SchedulerCache._mark_job``) that drives
+  micro-cycle wakes, so ``proportion``/``drf`` can seed their
+  session-open state from the ledger instead of sweeping every job.
+* :mod:`subgraph` — restricted-subgraph session construction: a
+  micro-cycle opens over only the jobs with schedulable work plus the
+  ledger's share seed (``Scheduler(restricted_sessions=True)`` /
+  ``--restricted-sessions``), with a shadow full-session cross-check
+  (every restricted cycle in tests, sampled in production) where ANY
+  binding divergence fails — and a seeded divergence plant proving the
+  checker catches a broken ledger.
+"""
+
+from volcano_tpu.incremental.shares import (  # noqa: F401
+    QueueShare,
+    ShareLedger,
+    ShareSeed,
+)
+
+# NOTE: :mod:`subgraph` is deliberately NOT imported here.  The cache
+# imports ``shares`` (which triggers this package __init__), while
+# ``subgraph`` imports the framework — which imports the cache package.
+# Importing subgraph at package level would close that cycle.  Consumers
+# (scheduler, tests) import ``volcano_tpu.incremental.subgraph``
+# directly.

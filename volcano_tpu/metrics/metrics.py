@@ -698,6 +698,47 @@ def register_full_cycle_fallback(cause: str) -> None:
     )
 
 
+# ---- incremental-session plane (volcano_tpu/incremental) ----
+# The 1M-resident-job story in four series: how many jobs are resident
+# vs actually schedulable (the micro-cycle working set), which scope
+# each session opened at, and the shadow cross-check verdict stream
+# that keeps the restricted path honest.
+
+
+def update_resident_jobs(count: int) -> None:
+    """volcano_resident_jobs: jobs resident in the scheduler cache
+    (everything with a PodGroup, running or pending) — the O(resident)
+    cost a full session pays and a restricted session does not."""
+    registry.set_gauge(f"{_NAMESPACE}_resident_jobs", {}, count)
+
+
+def update_schedulable_jobs(count: int) -> None:
+    """volcano_schedulable_jobs: jobs with schedulable pending work
+    (the share ledger's schedulable set) — the O(pending) working set a
+    restricted session opens over."""
+    registry.set_gauge(f"{_NAMESPACE}_schedulable_jobs", {}, count)
+
+
+def register_session_scope(mode: str) -> None:
+    """volcano_session_scope_total{mode}: one count per session opened,
+    by scope."""
+    # label-vocab: mode ∈ {full, restricted}, a static set
+    registry.inc(f"{_NAMESPACE}_session_scope_total", {"mode": mode})
+
+
+def register_share_ledger_drift_check(result: str) -> None:
+    """volcano_share_ledger_drift_checks_total{result}: one count per
+    shadow full-session cross-check of a restricted session.  Any
+    divergence in the bind/evict outcome sets counts as
+    result="divergence" (and raises in strict mode); a sustained ok
+    stream is the production evidence the incremental ledger tracks
+    swept truth."""
+    # label-vocab: result ∈ {ok, divergence}, a static set
+    registry.inc(
+        f"{_NAMESPACE}_share_ledger_drift_checks_total", {"result": result}
+    )
+
+
 def observe_watch_batch(size: int) -> None:
     """volcano_bus_watch_batch_size: how many watch events one coalesced
     T_WATCH_BATCH frame carried (bus/server.py writer-thread
@@ -749,6 +790,18 @@ def observe_shard_lease_renew(seconds: float) -> None:
     registry.histogram(
         f"{_NAMESPACE}_shard_lease_renew_latency_milliseconds", {}
     ).observe(seconds * 1e3)
+
+
+def register_sketch_solicitation(result: str) -> None:
+    """volcano_sketch_solicitations_total{result}: per-node outcomes of
+    sketch-solicited foreign candidates (federation/sketches.py).
+    result ∈ {verified (node truth read-back confirmed the sketch
+    entry), stale (the sketch advertised a node the store says is gone
+    or unschedulable — pruning signal, never a correctness event)}."""
+    # label-vocab: result ∈ {verified, stale}, a static set
+    registry.inc(
+        f"{_NAMESPACE}_sketch_solicitations_total", {"result": result}
+    )
 
 
 def register_gang_assembly(result: str) -> None:

@@ -47,6 +47,7 @@ from volcano_tpu.conf import (
 )
 from volcano_tpu.framework import close_session, get_action, open_session
 from volcano_tpu.framework.interface import Action
+from volcano_tpu.incremental import subgraph
 from volcano_tpu.metrics import metrics
 from volcano_tpu.utils.logging import get_logger
 
@@ -71,6 +72,9 @@ class Scheduler:
         cycle_deadline_ms: Optional[float] = None,
         micro_cycles: bool = False,
         micro_debounce_ms: float = 5.0,
+        restricted_sessions: bool = False,
+        shadow_every: int = 16,
+        shadow_strict: bool = False,
     ):
         self.cache = cache
         #: cycle watchdog (--cycle-deadline-ms): arms a process-global
@@ -117,9 +121,45 @@ class Scheduler:
         #: must see the cycle's outcome but never run concurrently with
         #: a session).  Exceptions are logged, never kill the loop.
         self.post_cycle: Optional[Callable[[], None]] = None
+        # ---- restricted-subgraph sessions (incremental/subgraph.py) ----
+        #: opt-in: micro-cycles whose conf is entirely within
+        #: RESTRICTABLE_ACTIONS open over only the jobs with schedulable
+        #: work plus the share ledger's seed — O(pending) instead of
+        #: O(resident).  Periodic full cycles are untouched.
+        self.restricted_sessions = restricted_sessions
+        #: shadow cross-check sampling: every Nth restricted cycle also
+        #: runs a store-inert FULL session over the same snapshot and
+        #: fails on ANY binding divergence.  1 = every restricted cycle
+        #: (the test setting), 0 = never.
+        self.shadow_every = shadow_every
+        #: strict mode raises ShadowDivergence instead of only counting
+        #: it in volcano_share_ledger_drift_checks_total{result}
+        self.shadow_strict = shadow_strict
+        self._restricted_since_shadow = 0
         #: observability for tests and bench/loadgen.py
         self.micro_cycles_run = 0
         self.full_cycles_run = 0
+        self.restricted_cycles_run = 0
+        self.shadow_divergences = 0
+        #: cumulative wall time spent opening sessions (snapshot +
+        #: plugin on_session_open; sampled shadow cross-checks excluded)
+        #: and the count behind the mean — loadgen --resident-sweep
+        #: gates the per-session open cost on these
+        self.session_open_seconds = 0.0
+        self.sessions_opened = 0
+        #: the restricted-only slice of the above: periodic full cycles
+        #: stay O(resident) by design, so the O(pending) claim is gated
+        #: on the micro-cycle (restricted) open cost alone.  Sampled
+        #: shadow-audit cycles pay an O(resident) shadow snapshot and
+        #: are excluded too — hence the separate cycle count.
+        self.restricted_open_seconds = 0.0
+        self.restricted_open_cycles = 0
+        #: per-cycle samples behind the sweep's MEDIAN gate (a single
+        #: GC/contention stall in a short CI run should not read as an
+        #: O(resident) regression); bounded so resident campaigns don't
+        #: grow it without limit
+        self.restricted_open_samples: List[float] = []
+        self.shadow_checks_run = 0
         #: conf hot-reload cache: (mtime_ns, size) of the last parse
         self._conf_key = None
         self._conf_cached: Optional[SchedulerConf] = None
@@ -271,11 +311,65 @@ class Scheduler:
         obs_span.__enter__()
         start = time.perf_counter()
         ssn = None
+        rec_cache = None
+        shadow_outcome = None
         try:
             conf = self._load_conf()
             actions = self._resolve_actions(conf)
 
-            ssn = open_session(self.cache, conf.tiers, conf.configurations)
+            restricted = (
+                micro
+                and self.restricted_sessions
+                and subgraph.conf_is_restrictable(conf.actions)
+                and getattr(self.cache, "share_ledger", None) is not None
+            )
+            if restricted:
+                shadow = self.shadow_every > 0 and (
+                    self._restricted_since_shadow + 1 >= self.shadow_every
+                )
+                # one atomic snapshot feeds BOTH the restricted session
+                # and (when sampled) its shadow full-session cross-check
+                # — the restricted job set is computed inside the cache
+                # mutex, so churn between two snapshots can never read
+                # as a false divergence
+                t_open = time.perf_counter()
+                snap = self.cache.snapshot(
+                    scope="shadow" if shadow else "restricted"
+                )
+                open_s = time.perf_counter() - t_open
+                if shadow:
+                    self._restricted_since_shadow = 0
+                    shadow_outcome = subgraph.run_shadow_session(
+                        self.cache, snap, conf.tiers,
+                        conf.configurations, actions,
+                    )
+                else:
+                    self._restricted_since_shadow += 1
+                t_open = time.perf_counter()
+                rec_cache = subgraph.RecordingCache(self.cache)
+                ssn = open_session(
+                    rec_cache, conf.tiers, conf.configurations,
+                    snapshot=snap, job_uids=snap.restricted_uids,
+                )
+                # the sampled shadow run between the two stamps is
+                # soundness auditing, not steady-state open cost
+                open_s += time.perf_counter() - t_open
+                if not shadow:
+                    self.restricted_open_seconds += open_s
+                    self.restricted_open_cycles += 1
+                    if len(self.restricted_open_samples) < 65536:
+                        self.restricted_open_samples.append(open_s)
+                self.restricted_cycles_run += 1
+                metrics.register_session_scope("restricted")
+            else:
+                t_open = time.perf_counter()
+                ssn = open_session(
+                    self.cache, conf.tiers, conf.configurations
+                )
+                open_s = time.perf_counter() - t_open
+                metrics.register_session_scope("full")
+            self.session_open_seconds += open_s
+            self.sessions_opened += 1
             for action in actions:
                 action_start = time.perf_counter()
                 with obs.span(f"action:{action.name()}", cat="action"):
@@ -287,6 +381,27 @@ class Scheduler:
                         f"action:{action.name()}", "action",
                         action_start, action_s,
                     )
+            if shadow_outcome is not None:
+                self.shadow_checks_run += 1
+                shadow_binds, shadow_evicts = shadow_outcome
+                diffs = subgraph.compare_outcomes(
+                    rec_cache.binds, rec_cache.evicts,
+                    shadow_binds, shadow_evicts,
+                )
+                if diffs is None:
+                    metrics.register_share_ledger_drift_check("ok")
+                else:
+                    self.shadow_divergences += 1
+                    metrics.register_share_ledger_drift_check("divergence")
+                    log.error(
+                        "restricted session diverged from shadow full "
+                        "session (%d diffs): %s",
+                        len(diffs), "; ".join(diffs),
+                    )
+                    if self.shadow_strict:
+                        # raised inside the try so close_session still
+                        # runs for the (real) restricted session
+                        raise subgraph.ShadowDivergence(diffs)
         finally:
             try:
                 # ssn is None when open_session itself crashed (a plugin
@@ -318,6 +433,11 @@ class Scheduler:
                 obs_span.__exit__(None, None, None)
                 self.cache.in_micro_cycle = False
         metrics.update_e2e_duration(elapsed)
+        counts = getattr(self.cache, "ledger_counts", None)
+        if counts is not None:
+            resident, schedulable = counts()
+            metrics.update_resident_jobs(resident)
+            metrics.update_schedulable_jobs(schedulable)
         if micro:
             self.micro_cycles_run += 1
             metrics.register_micro_cycle(trigger)
