@@ -1573,6 +1573,197 @@ def _resident_gates(report, grace_ms: float) -> list:
     return fails
 
 
+def run_slo_burn_drill(args) -> dict:
+    """The black-box drill (ISSUE 19): run the in-process topology with
+    the diagnostics plane armed — tail-sampled flight recorder, a
+    burn-rate watchdog over a TimeSeriesRing of the live registry, and
+    an IncidentManager — then inject a seeded ``commit.delay`` burst so
+    submit→bind p99 burns through its objective.  The breach must
+    edge-trigger EXACTLY ONE incident bundle, the bundle must land
+    while the cluster capture boost it CAS'd is still live and carry
+    the breach-window bind traces, and the watchdog must CLEAR once the
+    burst rolls out of its windows (main() gates all of it)."""
+    from volcano_tpu import faults, obs
+    from volcano_tpu.metrics.timeseries import TimeSeriesRing
+    from volcano_tpu.obs.incident import IncidentManager
+    from volcano_tpu.obs.slo import BurnRateWatchdog, resolve_slos
+
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as f:
+        f.write(CONF)
+        conf_path = f.name
+    incident_dir = args.incident_dir or tempfile.mkdtemp(
+        prefix="vtpu-incidents-")
+
+    topo = LoadgenTopology(
+        n_nodes=args.nodes, node_cpu=args.node_cpu, conf_path=conf_path,
+        period=args.period, debounce_ms=args.debounce_ms,
+        micro_cycles=not args.no_micro_cycles,
+    )
+    topo.complete_after_s = args.complete_after_s
+    # the diagnostics plane, exactly as a daemon wires it: tail-mode
+    # exporter (steady traces drop, evidence keeps), ring + watchdog,
+    # breach → incident manager (bundle + capture boost CAS)
+    obs.enable(topo.api, identity="loadgen-sched", flush_interval=0.1,
+               sample=0.05, tail=True)
+    ring = TimeSeriesRing()
+    mgr = IncidentManager(
+        topo.api, "loadgen-sched", incident_dir,
+        cooldown_s=300.0,  # one bundle per episode, guaranteed
+        boost_ttl_s=args.burn_boost_ttl, settle_s=1.5, metrics_ring=ring,
+    )
+    fast_s, slow_s = 3.0, 9.0
+    breach_ts: List[float] = []
+
+    def on_breach(alert):
+        breach_ts.append(time.time())
+        mgr.on_alert(alert)
+
+    # only the SLO the burst targets: the default set also watches
+    # micro-cycle latency etc., which CI-shape load can breach on its
+    # own and would double the episode count
+    slos = [s for s in resolve_slos(
+        f"submit-bind-p99={args.burn_objective_ms:g}")
+        if s.name == "submit-bind-p99"]
+    wd = BurnRateWatchdog(
+        ring, slos=slos,
+        fast_window_s=fast_s, slow_window_s=slow_s, on_breach=on_breach,
+    )
+    wd_stop = threading.Event()
+
+    def _wd_loop():
+        while not wd_stop.wait(0.5):
+            try:
+                wd.run_once()
+            except Exception:  # noqa: BLE001 — the drill gates on
+                pass           # outcomes, not watchdog uptime
+
+    degraded_during = False
+    cleared = False
+    try:
+        # warmup off the clock (jit compile latencies must not reach
+        # the ring — the watchdog only ever sees steady-state samples)
+        warm = topo.submit_job("burnwarm", 8, args.cpu)
+        deadline = time.monotonic() + args.warmup_timeout
+        while time.monotonic() < deadline:
+            if topo.bound_count(warm) == len(warm):
+                break
+            time.sleep(0.05)
+        if topo.bound_count(warm) != len(warm):
+            raise RuntimeError("warmup pods never bound")
+
+        threading.Thread(target=_wd_loop, name="burn-watchdog",
+                         daemon=True).start()
+        # both burn windows need history before they can confirm a
+        # breach — idle until the ring spans the slow window
+        deadline = time.monotonic() + 4.0 * slow_s
+        while time.monotonic() < deadline and ring.span_seconds() < slow_s:
+            time.sleep(0.25)
+
+        faults.configure(
+            f"seed=19;commit.delay=1.0:ms={int(args.burn_delay_ms)}")
+        phase = run_phase(topo, args.rate, args.duration,
+                          args.tasks_per_job, args.cpu,
+                          args.drain_timeout, label="burn")
+        degraded_during = bool(wd.degraded_reasons()) or bool(breach_ts)
+        faults.configure(None)
+        # the burst is over: the alert must CLEAR as the windows roll
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if not wd.degraded_reasons():
+                cleared = True
+                break
+            time.sleep(0.5)
+    finally:
+        wd_stop.set()
+        faults.configure(None)
+        obs.disable()
+        topo.close()
+        os.unlink(conf_path)
+
+    bundles = sorted(
+        d for d in (os.listdir(incident_dir)
+                    if os.path.isdir(incident_dir) else [])
+        if d.startswith("incident-")
+    )
+    bundle = {}
+    bundle_within_boost = False
+    bundle_has_bind_trace = False
+    if bundles:
+        bdir = os.path.join(incident_dir, bundles[0])
+        with open(os.path.join(bdir, "meta.json")) as f:
+            meta = json.load(f)
+        # captured while the boost it armed was still live
+        boost_until = float((meta.get("boost") or {}).get("until", 0.0))
+        bundle_within_boost = meta["ts"] <= boost_until
+        try:
+            with open(os.path.join(bdir, "spans.json")) as f:
+                spans = json.load(f)
+        except (OSError, ValueError):
+            spans = []
+        bundle_has_bind_trace = any(
+            s.get("name") == "bind:landed" for s in spans)
+        bundle = {
+            "path": bdir,
+            "reason": meta.get("reason"),
+            "alerts": meta.get("alerts"),
+            "span_count": meta.get("spanCount"),
+            "files": meta.get("files"),
+            "errors": meta.get("errors"),
+        }
+    return {
+        "config": {
+            "topology": "in-process",
+            "nodes": args.nodes,
+            "burn_delay_ms": args.burn_delay_ms,
+            "burn_objective_ms": args.burn_objective_ms,
+            "burn_boost_ttl_s": args.burn_boost_ttl,
+            "fast_window_s": fast_s,
+            "slow_window_s": slow_s,
+            "incident_dir": incident_dir,
+            "quick": args.quick,
+        },
+        "run": phase,
+        "drill": {
+            "breaches": len(breach_ts),
+            "degraded_during": degraded_during,
+            "degraded_cleared": cleared,
+            "bundles": len(bundles),
+            "bundle": bundle,
+            "bundle_within_boost": bundle_within_boost,
+            "bundle_has_bind_trace": bundle_has_bind_trace,
+            "suppressed_triggers": mgr.suppressed_triggers,
+        },
+    }
+
+
+def _burn_gates(report) -> list:
+    """Gate messages for a --slo-burn-drill report ([] = pass)."""
+    fails = []
+    r = report["run"]
+    d = report["drill"]
+    if r["bound_pods"] != r["submitted_pods"]:
+        fails.append(f"{r['submitted_pods'] - r['bound_pods']} pods "
+                     "never bound under the commit.delay burst")
+    if not d["breaches"]:
+        fails.append("the watchdog never fired — the seeded burst did "
+                     "not breach the burn threshold")
+    if not d["degraded_during"]:
+        fails.append("the breach never surfaced as a degraded reason")
+    if d["bundles"] != 1:
+        fails.append(f"{d['bundles']} incident bundles captured — the "
+                     "episode must produce exactly one")
+    elif not d["bundle_within_boost"]:
+        fails.append("the bundle landed after its capture boost "
+                     "expired (settle/TTL misconfigured)")
+    elif not d["bundle_has_bind_trace"]:
+        fails.append("the bundle carries no bind:landed span — the "
+                     "boost did not retain the breach-window traces")
+    if not d["degraded_cleared"]:
+        fails.append("the alert never cleared after the burst (stuck "
+                     "degraded state)")
+    return fails
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="loadgen", description="sustained-load submit→bind SLO harness"
@@ -1704,6 +1895,24 @@ def main(argv=None) -> int:
     p.add_argument("--resident-p99-grace-ms", type=float, default=10.0,
                    help="absolute grace added to the sweep's 1.2x p99 "
                    "gate (timer noise at CI shape)")
+    p.add_argument("--slo-burn-drill", action="store_true",
+                   help="black-box diagnostics drill: arm the burn-rate "
+                   "watchdog + incident manager over the in-process "
+                   "topology, inject a seeded commit.delay burst, and "
+                   "gate that the breach produces exactly one incident "
+                   "bundle within the capture-boost TTL carrying the "
+                   "breach-window traces, then clears")
+    p.add_argument("--incident-dir", default="",
+                   help="where the drill's incident bundles land "
+                   "(default: a fresh temp dir; CI points this at the "
+                   "artifact upload path)")
+    p.add_argument("--burn-delay-ms", type=float, default=150.0,
+                   help="per-commit injected delay during the burst")
+    p.add_argument("--burn-objective-ms", type=float, default=50.0,
+                   help="submit-bind-p99 objective the drill burns "
+                   "through")
+    p.add_argument("--burn-boost-ttl", type=float, default=15.0,
+                   help="capture-boost TTL the breach arms")
     p.add_argument("--quick", action="store_true",
                    help="CI smoke preset: small fleet, short stream")
     args = p.parse_args(argv)
@@ -1739,11 +1948,26 @@ def main(argv=None) -> int:
             # times over before churn can free it
             args.rate = 5.0
             args.drain_timeout = 120.0
+        if args.slo_burn_drill:
+            # the burn windows need the burst to SPAN them: a longer,
+            # gentler stream so the breach, the settled capture, and
+            # post-breach binds all land inside the measured phase
+            args.rate = 15.0
+            args.duration = 8.0
         if args.resident_sweep and args.resident == 0:
             # 100 → 1000 resident jobs across the sweep: enough that an
             # O(resident) open cost would blow the 2x gate, small
             # enough for CI
             args.resident = 100
+
+    if args.slo_burn_drill:
+        report = run_slo_burn_drill(args)
+        json.dump(report, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        fails = _burn_gates(report)
+        for msg in fails:
+            print(f"LOADGEN FAIL: {msg}", file=sys.stderr)
+        return 1 if fails else 0
 
     if args.resident_sweep:
         if args.resident <= 0:

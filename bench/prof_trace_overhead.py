@@ -11,6 +11,12 @@ ISSUE 12 extends the gate to the flight-recorder SPAN path
 at default sampling must stay under +5%, and tracing OFF must cost
 zero (the null-span fast path) — both measured here.
 
+ISSUE 19 extends it once more to TAIL mode (keep/drop decided at
+trace completion): spans buffer in the exporter's pending pool
+instead of dropping at the head coin, so the measured cost now
+includes the per-span offer + per-kind duration bookkeeping.  Same
+budget: under +5% over the spans-off baseline.
+
 Emits one JSON line per mode plus a summary line with the delta, like
 the other bench/prof_*.py scripts.
 """
@@ -132,4 +138,34 @@ print(json.dumps({
     "off_vs_baseline_pct": round(span_off_delta_pct, 2),
     "budget_pct": 5.0,
     "within_budget": span_overhead_pct < 5.0,
+}))
+
+# ---- tail-based retention path (ISSUE 19) ----
+
+sink = APIServer()
+exporter = obs.enable(sink, identity="prof-trace-overhead",
+                      sample=0.01, tail=True)
+try:
+    tail_ms = cycle_ms()
+    tail_stats = {
+        "pending_traces": exporter.tail.pending_count(),
+        "kept_traces": exporter.tail.kept_traces,
+        "dropped_traces": exporter.tail.dropped_traces,
+        "evicted_traces": exporter.tail.evicted_traces,
+    }
+finally:
+    obs.disable()
+print(json.dumps({"metric": "span_cycle_latency", "mode": "tail",
+                  "value": round(tail_ms, 3), "unit": "ms",
+                  **tail_stats}))
+
+tail_overhead_pct = (tail_ms - spans_off_ms) / spans_off_ms * 100.0
+print(json.dumps({
+    "metric": "tail_overhead",
+    "value": round(tail_overhead_pct, 2),
+    "unit": "%",
+    "spans_off_ms": round(spans_off_ms, 3),
+    "tail_ms": round(tail_ms, 3),
+    "budget_pct": 5.0,
+    "within_budget": tail_overhead_pct < 5.0,
 }))

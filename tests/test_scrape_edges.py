@@ -1,0 +1,168 @@
+"""Edge cases of the /metrics read side (metrics/scrape.py) — the
+parser, cross-member histogram merge, the bucket-quantile answer, and
+the windowed delta that ``vtctl top --interval`` and the burn-rate
+watchdog's TimeSeriesRing both stand on.  These paths see hostile
+input by construction (half-scraped exposition text, restarted
+processes, members on different build's bucket bounds), so each edge
+is pinned explicitly."""
+
+from __future__ import annotations
+
+import pytest
+
+from volcano_tpu.metrics.scrape import (
+    delta,
+    histogram_quantile,
+    merge_histograms,
+    parse_metrics,
+)
+
+
+class TestParseMetrics:
+    def test_skips_comments_blanks_and_malformed_lines(self):
+        s = parse_metrics(
+            "# HELP volcano_x_total help text\n"
+            "# TYPE volcano_x_total counter\n"
+            "\n"
+            "volcano_x_total 3\n"
+            "volcano_y_total not-a-number\n"
+            "}{ garbage line\n"
+            'volcano_z{queue="q1"} 2.5\n'
+        )
+        assert s.value("volcano_x_total") == 3.0
+        assert s.value("volcano_y_total") == 0.0
+        assert s.value("volcano_z", queue="q1") == 2.5
+
+    def test_value_partial_match_sums_across_series(self):
+        s = parse_metrics(
+            'volcano_pods{daemon="sched",shard="a"} 1\n'
+            'volcano_pods{daemon="sched",shard="b"} 2\n'
+            'volcano_pods{daemon="ctrl"} 10\n'
+        )
+        assert s.value("volcano_pods") == 13.0
+        assert s.value("volcano_pods", daemon="sched") == 3.0
+        assert s.value("volcano_pods", shard="b") == 2.0
+        assert s.value("volcano_pods", shard="nope") == 0.0
+
+    def test_histogram_reassembles_sum_count_and_sorted_buckets(self):
+        s = parse_metrics(
+            'volcano_lat_milliseconds_bucket{le="+Inf"} 4\n'
+            'volcano_lat_milliseconds_bucket{le="10"} 3\n'
+            'volcano_lat_milliseconds_bucket{le="5"} 1\n'
+            "volcano_lat_milliseconds_sum 21.0\n"
+            "volcano_lat_milliseconds_count 4\n"
+        )
+        h = s.histogram("volcano_lat_milliseconds")
+        assert h == {"buckets": [("5", 1.0), ("10", 3.0), ("+Inf", 4.0)],
+                     "sum": 21.0, "count": 4.0}
+
+    def test_orphan_sum_count_without_buckets_stay_plain_series(self):
+        # _sum/_count lines with no _bucket sibling are somebody
+        # else's counter, not a histogram fragment
+        s = parse_metrics("volcano_thing_count 7\n")
+        assert s.histogram("volcano_thing") is None
+        assert s.value("volcano_thing_count") == 7.0
+
+
+class TestMergeHistograms:
+    def test_empty_input_merges_to_empty(self):
+        assert merge_histograms([]) == {"buckets": [], "sum": 0.0,
+                                        "count": 0.0}
+
+    def test_same_shape_merges_pointwise(self):
+        h1 = {"buckets": [("5", 1.0), ("+Inf", 2.0)],
+              "sum": 12.0, "count": 2.0}
+        h2 = {"buckets": [("5", 3.0), ("+Inf", 3.0)],
+              "sum": 9.0, "count": 3.0}
+        assert merge_histograms([h1, h2]) == {
+            "buckets": [("5", 4.0), ("+Inf", 5.0)],
+            "sum": 21.0, "count": 5.0,
+        }
+
+    def test_mismatched_boundaries_merge_by_bound(self):
+        # a member on different bucket bounds must not corrupt the
+        # fleet merge: stray bounds interleave, +Inf sorts last
+        h1 = {"buckets": [("5", 3.0), ("+Inf", 4.0)],
+              "sum": 20.0, "count": 4.0}
+        h2 = {"buckets": [("10", 2.0), ("+Inf", 2.0)],
+              "sum": 12.0, "count": 2.0}
+        assert merge_histograms([h1, h2]) == {
+            "buckets": [("5", 3.0), ("10", 2.0), ("+Inf", 6.0)],
+            "sum": 32.0, "count": 6.0,
+        }
+
+    def test_missing_keys_default_to_zero(self):
+        assert merge_histograms([{}, {"sum": 1.0}]) == {
+            "buckets": [], "sum": 1.0, "count": 0.0}
+
+
+class TestHistogramQuantile:
+    def test_empty_or_missing_histogram_is_zero(self):
+        assert histogram_quantile(None, 0.99) == 0.0
+        assert histogram_quantile({"buckets": [], "count": 0.0}, 0.5) == 0.0
+        assert histogram_quantile(merge_histograms([]), 0.99) == 0.0
+
+    def test_linear_interpolation_within_winning_bucket(self):
+        h = {"buckets": [("10", 5.0), ("20", 10.0), ("+Inf", 10.0)],
+             "sum": 0.0, "count": 10.0}
+        assert histogram_quantile(h, 0.5) == pytest.approx(10.0)
+        assert histogram_quantile(h, 0.75) == pytest.approx(15.0)
+        assert histogram_quantile(h, 0.25) == pytest.approx(5.0)
+
+    def test_inf_winning_bucket_answers_its_lower_bound(self):
+        h = {"buckets": [("10", 5.0), ("+Inf", 10.0)],
+             "sum": 0.0, "count": 10.0}
+        # the observation is somewhere past the last finite bound —
+        # the only honest answer is that bound, not infinity
+        assert histogram_quantile(h, 0.99) == 10.0
+
+    def test_all_mass_in_inf_bucket_answers_zero(self):
+        h = {"buckets": [("+Inf", 10.0)], "sum": 0.0, "count": 10.0}
+        assert histogram_quantile(h, 0.5) == 0.0
+
+    def test_empty_middle_bucket_does_not_divide_by_zero(self):
+        # cum == prev_cum in the winning bucket: interpolation would
+        # divide by zero — the quantile falls back to the lower bound
+        h = {"buckets": [("10", 0.0), ("20", 0.0), ("+Inf", 4.0)],
+             "sum": 0.0, "count": 4.0}
+        assert histogram_quantile(h, 0.5) == 20.0
+
+
+class TestDelta:
+    def test_counters_subtract_gauges_keep_later_value(self):
+        earlier = parse_metrics(
+            "volcano_binds_total 10\nvolcano_repl_lag_entries 100\n")
+        later = parse_metrics(
+            "volcano_binds_total 15\nvolcano_repl_lag_entries 3\n")
+        d = delta(later, earlier)
+        assert d.value("volcano_binds_total") == 5.0
+        assert d.value("volcano_repl_lag_entries") == 3.0
+
+    def test_counter_regression_reads_as_restart(self):
+        # a restarted member resets its counters to zero: the later
+        # value IS the window, never a negative rate
+        earlier = parse_metrics("volcano_binds_total 1000\n")
+        later = parse_metrics("volcano_binds_total 7\n")
+        assert delta(later, earlier).value("volcano_binds_total") == 7.0
+
+    def test_histogram_delta_clamps_regressions_to_zero(self):
+        earlier = parse_metrics(
+            'volcano_lat_ms_bucket{le="5"} 8\n'
+            'volcano_lat_ms_bucket{le="+Inf"} 9\n'
+            "volcano_lat_ms_sum 50.0\n"
+            "volcano_lat_ms_count 9\n"
+        )
+        later = parse_metrics(
+            'volcano_lat_ms_bucket{le="5"} 2\n'
+            'volcano_lat_ms_bucket{le="+Inf"} 12\n'
+            "volcano_lat_ms_sum 40.0\n"
+            "volcano_lat_ms_count 12\n"
+        )
+        h = delta(later, earlier).histogram("volcano_lat_ms")
+        assert h == {"buckets": [("5", 0.0), ("+Inf", 3.0)],
+                     "sum": 0.0, "count": 3.0}
+
+    def test_series_missing_from_earlier_scrape_counts_whole(self):
+        earlier = parse_metrics("")
+        later = parse_metrics("volcano_binds_total 4\n")
+        assert delta(later, earlier).value("volcano_binds_total") == 4.0

@@ -35,6 +35,7 @@ from __future__ import annotations
 import queue
 import random
 import socket
+import sys
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -471,6 +472,7 @@ class RemoteAPIServer:
             raise BusError("bus client closed")
         timeout = timeout if timeout is not None else self.timeout
         method = payload.get("op", "ping")
+        client_span = None
         if mtype == protocol.T_REQ:
             # cross-process correlation: stamp the scheduling-cycle id on
             # the request frame so server-side records (trace events, op
@@ -488,8 +490,29 @@ class RemoteAPIServer:
 
             span_ctx = obs.current_wire()
             if span_ctx is not None and "span" not in payload:
-                payload["span"] = span_ctx
+                # client half of the paired bus span: same name as the
+                # server's adopted ``bus:<op>`` span, linked parent →
+                # child across the wire.  The pair is what
+                # obs/collect.py's clock-skew estimator keys on (RTT
+                # midpoints), and its duration is the client-PERCEIVED
+                # rpc time — which a server-side bus.delay fault
+                # inflates, making slow hops tail-keepable anomalies.
+                client_span = obs.span(
+                    "bus:" + method, cat="bus",
+                    args={"peer": self.address},
+                )
+                client_span.__enter__()
+                payload["span"] = obs.current_wire() or span_ctx
         start = time.perf_counter()
+        try:
+            return self._call_framed(payload, timeout, mtype, method,
+                                     start, on_reply)
+        finally:
+            if client_span is not None:
+                client_span.__exit__(*sys.exc_info())
+
+    def _call_framed(self, payload: dict, timeout: float, mtype: int,
+                     method: str, start: float, on_reply) -> dict:
         if not self._connected.wait(timeout):
             metrics.observe_bus_request(method, time.perf_counter() - start,
                                         "disconnected")

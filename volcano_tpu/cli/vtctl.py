@@ -844,13 +844,47 @@ def _top_targets(vc: VolcanoClient, args) -> Dict[str, str]:
     return targets
 
 
+def _max_burn(s) -> float:
+    """Worst fast-window SLO burn rate in one scrape — max over the
+    ``volcano_slo_burn{window="fast"}`` series (summing across SLOs
+    would manufacture a breach out of several healthy ones)."""
+    values = [
+        v for (name, labels), v in s.series.items()
+        if name == "volcano_slo_burn" and ("window", "fast") in labels
+    ]
+    return max(values) if values else 0.0
+
+
 def _top(vc: VolcanoClient, args, out) -> int:
-    """Aggregate /metrics across the whole membership: one row per
-    member (scheduler shards from the lease map, apiserver replicas
-    from the endpoint list) plus a cluster-wide TOTAL row.  With
-    ``--interval S`` two scrapes bound a window and the counters/
-    histograms become rates and windowed percentiles; otherwise the
-    columns are process-lifetime cumulative."""
+    """Aggregate /metrics across the whole membership (one row per
+    member + a cluster TOTAL row); ``--watch N`` redraws every N
+    seconds (``--count`` bounds the frames), ``--json`` emits the same
+    numbers machine-readably."""
+    import time as _time
+
+    watch = getattr(args, "watch", 0.0) or 0.0
+    if watch <= 0:
+        return _top_once(vc, args, out)
+    count = getattr(args, "count", 0) or 0
+    frames = 0
+    rc = 0
+    try:
+        while True:
+            rc = _top_once(vc, args, out)
+            frames += 1
+            if count and frames >= count:
+                return rc
+            _time.sleep(watch)
+            print("", file=out)
+    except KeyboardInterrupt:
+        return rc
+
+
+def _top_once(vc: VolcanoClient, args, out) -> int:
+    """One ``vtctl top`` frame: per-member rows + a cluster-wide TOTAL
+    row.  With ``--interval S`` two scrapes bound a window and the
+    counters/histograms become rates and windowed percentiles;
+    otherwise the columns are process-lifetime cumulative."""
     import time as _time
 
     from volcano_tpu.metrics import scrape as _scrape
@@ -890,7 +924,7 @@ def _top(vc: VolcanoClient, args, out) -> int:
         print("every scrape failed", file=out)
         return 1
 
-    def row(label: str, s) -> str:
+    def stats_for(s) -> dict:
         q = _scrape.histogram_quantile
         cycles = s.histogram("volcano_e2e_scheduling_latency_milliseconds")
         commit = _scrape.merge_histograms([h for h in (
@@ -899,27 +933,35 @@ def _top(vc: VolcanoClient, args, out) -> int:
             *(s.histogram("volcano_bus_server_request_latency_milliseconds",
                           op=op) for op in _COMMIT_OPS),
         ) if h])
+        return {
+            "cycles": int((cycles or {}).get("count", 0)),
+            "binds": int(s.value("volcano_pod_schedule_successes")),
+            "s2bP99Ms": q(s.histogram(
+                "volcano_submit_to_bind_latency_milliseconds"), 0.99),
+            "commitP99Ms": q(commit, 0.99),
+            "fsyncP99Ms": q(s.histogram(
+                "volcano_wal_fsync_latency_milliseconds"), 0.99),
+            "quorumP99Ms": q(s.histogram(
+                "volcano_repl_quorum_wait_milliseconds"), 0.99),
+            "dropped": int(s.value("volcano_telemetry_dropped_total")),
+            "burn": _max_burn(s),
+        }
+
+    def row(label: str, st: dict) -> str:
         return (
             f"  {label:<30}"
-            f"{int((cycles or {}).get('count', 0)):<8}"
-            f"{int(s.value('volcano_pod_schedule_successes')):<8}"
-            f"{q(s.histogram('volcano_submit_to_bind_latency_milliseconds'), 0.99):<9.1f}"
-            f"{q(commit, 0.99):<11.1f}"
-            f"{q(s.histogram('volcano_wal_fsync_latency_milliseconds'), 0.99):<10.1f}"
-            f"{q(s.histogram('volcano_repl_quorum_wait_milliseconds'), 0.99):<11.1f}"
-            f"{int(s.value('volcano_telemetry_dropped_total')):<8}"
+            f"{st['cycles']:<8}"
+            f"{st['binds']:<8}"
+            f"{st['s2bP99Ms']:<9.1f}"
+            f"{st['commitP99Ms']:<11.1f}"
+            f"{st['fsyncP99Ms']:<10.1f}"
+            f"{st['quorumP99Ms']:<11.1f}"
+            f"{st['dropped']:<8}"
+            f"{st['burn']:<6.2f}"
         )
 
-    print(f"Cluster metrics ({window}; {len(scrapes)} member(s)):",
-          file=out)
-    print(
-        f"  {'MEMBER':<30}{'CYCLES':<8}{'BINDS':<8}{'S2B-99':<9}"
-        f"{'COMMIT-99':<11}{'FSYNC-99':<10}{'QUORUM-99':<11}{'DROPPED':<8}",
-        file=out,
-    )
-    for label in sorted(scrapes):
-        print(row(label, scrapes[label]), file=out)
-    # cluster-wide: histograms merge pointwise, counters sum
+    # cluster-wide: histograms merge pointwise, counters sum; the BURN
+    # column takes the fleet max (a burn is a per-process judgement)
     total = _scrape.Scrape()
     for s in scrapes.values():
         for key, v in s.series.items():
@@ -933,10 +975,148 @@ def _top(vc: VolcanoClient, args, out) -> int:
             total.histograms[key] = (
                 _scrape.merge_histograms([cur, h]) if cur else h
             )
-    print(row("CLUSTER", total), file=out)
+    member_stats = {label: stats_for(scrapes[label])
+                    for label in sorted(scrapes)}
+    cluster = stats_for(total)
+    cluster["burn"] = max(
+        [st["burn"] for st in member_stats.values()], default=0.0
+    )
+    if getattr(args, "json", False):
+        import json as _json
+
+        report = {"window": window, "members": member_stats,
+                  "cluster": cluster}
+        if interval > 0:
+            report["bindRatePerS"] = round(cluster["binds"] / interval, 3)
+        print(_json.dumps(report, indent=1, sort_keys=True), file=out)
+        return 0
+    print(f"Cluster metrics ({window}; {len(scrapes)} member(s)):",
+          file=out)
+    print(
+        f"  {'MEMBER':<30}{'CYCLES':<8}{'BINDS':<8}{'S2B-99':<9}"
+        f"{'COMMIT-99':<11}{'FSYNC-99':<10}{'QUORUM-99':<11}{'DROPPED':<8}"
+        f"{'BURN':<6}",
+        file=out,
+    )
+    for label, st in member_stats.items():
+        print(row(label, st), file=out)
+    print(row("CLUSTER", cluster), file=out)
     if interval > 0:
-        binds = int(total.value("volcano_pod_schedule_successes"))
-        print(f"  cluster bind rate: {binds / interval:.1f}/s", file=out)
+        print(f"  cluster bind rate: {cluster['binds'] / interval:.1f}/s",
+              file=out)
+    return 0
+
+
+def _select_incidents(vc: VolcanoClient, args):
+    from volcano_tpu import obs
+
+    records = obs.list_incidents(vc.api)
+    identity = getattr(args, "identity", "") or ""
+    if identity:
+        records = [r for r in records
+                   if r["meta"].get("identity") == identity]
+    return records
+
+
+def _fmt_ts(ts: float) -> str:
+    """Stored capture timestamp → fixed UTC rendering (derived from
+    stored fields only — the byte-identity discipline)."""
+    import datetime as _dt
+
+    return _dt.datetime.utcfromtimestamp(ts).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+def _incidents_list(vc: VolcanoClient, args, out) -> int:
+    records = _select_incidents(vc, args)
+    if not records:
+        print("no incident bundles published on this bus", file=out)
+        return 0
+    print(f"  {'#':<4}{'WHEN (UTC)':<22}{'IDENTITY':<24}{'TRIGGER':<28}"
+          f"{'SPANS':<7}ALERTS", file=out)
+    for i, rec in enumerate(records):
+        meta = rec["meta"]
+        alerts = ",".join(a.get("name", "?")
+                          for a in meta.get("alerts") or []) or "-"
+        print(
+            f"  {i:<4}{_fmt_ts(meta.get('ts', 0.0)):<22}"
+            f"{meta.get('identity', '?'):<24}"
+            f"{meta.get('reason', '?'):<28}"
+            f"{len(rec['spans']):<7}{alerts}",
+            file=out,
+        )
+    return 0
+
+
+def _incidents_show(vc: VolcanoClient, args, out) -> int:
+    import json as _json
+
+    from volcano_tpu import obs
+
+    records = _select_incidents(vc, args)
+    if not records:
+        print("no matching incident bundle", file=out)
+        return 1
+    index = args.index if args.index is not None else len(records) - 1
+    if not 0 <= index < len(records):
+        print(f"error: index {index} out of range "
+              f"(0..{len(records) - 1})", file=out)
+        return 1
+    rec = records[index]
+    meta = dict(rec["meta"])
+    print(f"incident {rec['object']}:", file=out)
+    print(_json.dumps(meta, indent=1, sort_keys=True), file=out)
+    if rec["spans"]:
+        print("", file=out)
+        obs.render_waterfall(rec["spans"], out)
+    return 0
+
+
+def _incidents_collect(vc: VolcanoClient, args, out) -> int:
+    """Pull every member's published incident summary into one local
+    directory — the fleet-wide black-box retrieval."""
+    import json as _json
+    import os as _os
+
+    records = _select_incidents(vc, args)
+    if not records:
+        print("no incident bundles published on this bus", file=out)
+        return 0
+    _os.makedirs(args.out, exist_ok=True)
+    for rec in records:
+        path = _os.path.join(args.out, f"{rec['object']}.json")
+        with open(path, "w") as f:
+            _json.dump(rec, f, indent=1, sort_keys=True)
+    print(f"collected {len(records)} incident summar"
+          f"{'y' if len(records) == 1 else 'ies'} into {args.out}",
+          file=out)
+    return 0
+
+
+def _incidents_capture(vc: VolcanoClient, args, out) -> int:
+    """Operator-initiated capture: arm the cluster-wide boost, wait
+    the settle window so boosted-fidelity spans land, write a bundle
+    locally from whatever the bus holds."""
+    from volcano_tpu.obs.incident import IncidentManager, set_capture_boost
+
+    identity = args.identity or "vtctl"
+    try:
+        boost = set_capture_boost(vc.api, identity, "manual",
+                                  args.boost_ttl)
+    except Exception as e:  # noqa: BLE001 — boostless capture still
+        # beats no capture
+        print(f"  capture-boost CAS failed ({e}); capturing unboosted",
+              file=out)
+        boost = None
+    if args.settle > 0:
+        time.sleep(args.settle)
+    mgr = IncidentManager(
+        vc.api, identity, args.dir,
+        boost_ttl_s=args.boost_ttl, settle_s=0.0,
+    )
+    path = mgr.capture("manual", detail="vtctl incidents capture",
+                       boost=boost)
+    print(f"bundle: {path}", file=out)
     return 0
 
 
@@ -1109,6 +1289,60 @@ def build_parser() -> argparse.ArgumentParser:
         help="seconds between two scrapes: columns become windowed "
         "rates/percentiles instead of process-lifetime cumulative",
     )
+    top.add_argument(
+        "--watch", type=float, default=0.0, metavar="N",
+        help="redraw every N seconds until interrupted",
+    )
+    top.add_argument(
+        "--count", type=int, default=0,
+        help="with --watch: stop after this many frames (0 = forever)",
+    )
+    top.add_argument(
+        "--json", action="store_true",
+        help="emit the per-member and cluster stats as JSON",
+    )
+
+    inc = sub.add_parser(
+        "incidents", aliases=["incident"],
+        description="cluster incident bundles — the black box the SLO "
+        "burn-rate watchdog (or an operator) captures at a breach: "
+        "kept traces, metrics window, bus/shard state, capture-boost "
+        "record (volcano_tpu/obs/incident.py)",
+    ).add_subparsers(dest="cmd", required=True)
+    il = inc.add_parser(
+        "list", description="every incident summary published on the "
+        "bus, fleet-wide, oldest first",
+    )
+    il.add_argument("--identity", default="",
+                    help="only bundles captured by this daemon identity")
+    ish = inc.add_parser(
+        "show", description="one incident's meta + the breach-window "
+        "waterfall, from the stored summary",
+    )
+    ish.add_argument("--identity", default="")
+    ish.add_argument("--index", type=int, default=None,
+                     help="row from `incidents list` (default: latest)")
+    ic = inc.add_parser(
+        "collect", description="download every member's published "
+        "incident summary into a local directory",
+    )
+    ic.add_argument("--identity", default="")
+    ic.add_argument("--out", "-o", required=True,
+                    help="destination directory")
+    icap = inc.add_parser(
+        "capture", description="operator-initiated capture: CAS the "
+        "cluster-wide capture boost, wait --settle seconds for "
+        "full-fidelity spans to land, write one bundle locally",
+    )
+    icap.add_argument("--dir", "-d", required=True,
+                      help="bundle ring directory")
+    icap.add_argument("--identity", default="",
+                      help="identity stamped on the bundle "
+                      "(default 'vtctl')")
+    icap.add_argument("--settle", type=float, default=2.0,
+                      help="seconds between boost and bundle write")
+    icap.add_argument("--boost-ttl", type=float, default=30.0,
+                      help="capture-boost TTL seconds")
 
     faults_p = sub.add_parser(
         "faults",
@@ -1171,6 +1405,15 @@ _HANDLERS = {
     ("trace", "export"): _trace_export,
     ("trace", "pod"): _trace_pod,
     ("trace", "gang"): _trace_gang,
+    ("incidents", "list"): _incidents_list,
+    ("incidents", "show"): _incidents_show,
+    ("incidents", "collect"): _incidents_collect,
+    ("incidents", "capture"): _incidents_capture,
+    # the singular alias parses with group="incident"
+    ("incident", "list"): _incidents_list,
+    ("incident", "show"): _incidents_show,
+    ("incident", "collect"): _incidents_collect,
+    ("incident", "capture"): _incidents_capture,
 }
 
 

@@ -88,6 +88,8 @@ def main(argv=None) -> int:
         listen_port=args.listen_port,
         debug_enabled=args.enable_debug_stacks,
         flight_recorder=True if args.flight_recorder else None,
+        # --watchdog is a no-op here: the webhook daemon runs no work
+        # loop and owns none of the declared SLO signals
     )
     daemon.start()
     try:

@@ -66,6 +66,8 @@ class ApiServerDaemon:
         replica_index: int = 0,
         repl_lease_ttl: float = 2.0,
         flight_recorder: Optional[bool] = None,
+        watchdog: Optional[bool] = None,
+        incident_dir: Optional[str] = None,
     ):
         if flight_recorder is None:
             flight_recorder = os.environ.get(
@@ -73,6 +75,14 @@ class ApiServerDaemon:
             ) not in ("", "0")
         self.flight_recorder = flight_recorder
         self._obs_exporter = None
+        if watchdog is None:
+            watchdog = os.environ.get("VTPU_WATCHDOG", "") not in ("", "0")
+        if incident_dir is None:
+            incident_dir = os.environ.get("VTPU_INCIDENT_DIR", "")
+        self.watchdog_enabled = watchdog
+        self.incident_dir = incident_dir
+        self.watchdog = None
+        self.incidents = None
         self.replica_index = replica_index
         self.replica = None
         if api is not None:
@@ -106,6 +116,31 @@ class ApiServerDaemon:
                 self.api, replicas, replica_index,
                 lease_ttl=repl_lease_ttl,
                 on_became_leader=self._seed_if_configured,
+            )
+        if self.watchdog_enabled:
+            from volcano_tpu.metrics.timeseries import TimeSeriesRing
+            from volcano_tpu.obs.incident import IncidentManager
+            from volcano_tpu.obs.slo import BurnRateWatchdog
+
+            identity = f"apiserver-{replica_index}"
+            ring = TimeSeriesRing()
+            self.incidents = IncidentManager(
+                self.api, identity,
+                self.incident_dir
+                or os.path.join("/tmp", f"vtpu-incidents-{identity}"),
+                cooldown_s=float(
+                    os.environ.get("VTPU_INCIDENT_COOLDOWN", "60")),
+                boost_ttl_s=float(os.environ.get("VTPU_BOOST_TTL", "30")),
+                metrics_ring=ring,
+            )
+            self.watchdog = BurnRateWatchdog(
+                ring=ring,
+                fast_window_s=float(
+                    os.environ.get("VTPU_SLO_FAST_WINDOW", "60")),
+                slow_window_s=float(
+                    os.environ.get("VTPU_SLO_SLOW_WINDOW", "300")),
+                period=float(os.environ.get("VTPU_WATCHDOG_PERIOD", "5")),
+                on_breach=self.incidents.on_alert,
             )
         self.bus = BusServer(
             self.api, host=listen_host, port=bus_port,
@@ -161,7 +196,9 @@ class ApiServerDaemon:
                 return "below-quorum"
         from volcano_tpu.faults.breaker import degraded_reasons
 
-        reasons = degraded_reasons()
+        reasons = list(degraded_reasons())
+        if self.watchdog is not None:
+            reasons.extend(self.watchdog.degraded_reasons())
         return ", ".join(reasons) if reasons else None
 
     def _seed_if_configured(self) -> None:
@@ -218,6 +255,8 @@ class ApiServerDaemon:
             self._obs_exporter = obs.enable(
                 self.api, identity=f"apiserver-{self.replica_index}"
             )
+        if self.watchdog is not None:
+            self.watchdog.start()
         if self.replica is not None:
             self.replica.start()
         log.info(
@@ -229,6 +268,8 @@ class ApiServerDaemon:
         return self
 
     def stop(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
         if self.replica is not None:
             self.replica.stop()
         self.bus.stop()
@@ -301,6 +342,16 @@ def main(argv=None) -> int:
         "(volcano_tpu/obs; also VTPU_FLIGHT_RECORDER=1)",
     )
     parser.add_argument(
+        "--watchdog", action="store_true",
+        help="SLO burn-rate watchdog over this replica's own metrics "
+        "(repl lag, commit failures, breaker state); breaches degrade "
+        "/healthz and write incident bundles (also VTPU_WATCHDOG=1)",
+    )
+    parser.add_argument(
+        "--incident-dir", default=None,
+        help="incident-bundle ring directory (also VTPU_INCIDENT_DIR)",
+    )
+    parser.add_argument(
         "--shm", action="store_true",
         help="also listen on the same-host shared-memory ring "
         "transport (bus/shm.py; also VTPU_BUS_SHM=1 — what local_up "
@@ -330,6 +381,8 @@ def main(argv=None) -> int:
         replica_index=args.replica_index,
         repl_lease_ttl=args.repl_lease_ttl,
         flight_recorder=True if args.flight_recorder else None,
+        watchdog=True if args.watchdog else None,
+        incident_dir=args.incident_dir,
     ).start()
     try:
         threading.Event().wait()

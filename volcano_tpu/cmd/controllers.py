@@ -61,6 +61,8 @@ def main(argv=None) -> int:
             identity=args.leader_elect_id,
             debug_enabled=args.enable_debug_stacks,
             flight_recorder=True if args.flight_recorder else None,
+            watchdog=True if args.watchdog else None,
+            incident_dir=args.incident_dir,
         )
     )
 

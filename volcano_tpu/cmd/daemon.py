@@ -43,6 +43,8 @@ class BaseDaemon:
         debug_enabled: bool = False,
         explain_source=None,
         flight_recorder: Optional[bool] = None,
+        watchdog: Optional[bool] = None,
+        incident_dir: Optional[str] = None,
     ):
         self.api = api
         self.period = period
@@ -57,15 +59,55 @@ class BaseDaemon:
             ) not in ("", "0")
         self.flight_recorder = flight_recorder
         self._obs_exporter = None
+        #: SLO burn-rate watchdog (obs/slo.py) + incident bundles
+        #: (obs/incident.py).  None = follow VTPU_WATCHDOG /
+        #: VTPU_INCIDENT_DIR so the drill harnesses flip every daemon
+        #: with env vars, same shape as the flight recorder flag.
+        if watchdog is None:
+            watchdog = os.environ.get("VTPU_WATCHDOG", "") not in ("", "0")
+        if incident_dir is None:
+            incident_dir = os.environ.get("VTPU_INCIDENT_DIR", "")
+        self.watchdog_enabled = watchdog
+        self.incident_dir = incident_dir
+        self.watchdog = None
+        self.incidents = None
         #: uniform identity labels merged into every /metrics series
         #: (vtctl top's federation contract); subclasses refine
         self.identity_labels = {
             "daemon": self.NAME.replace("vtpu-", ""),
             "role": self.NAME.replace("vtpu-", ""),
         }
+        if self.watchdog_enabled:
+            from volcano_tpu.metrics.timeseries import TimeSeriesRing
+            from volcano_tpu.obs.incident import IncidentManager
+            from volcano_tpu.obs.slo import BurnRateWatchdog
+
+            ring = TimeSeriesRing()
+            self.incidents = IncidentManager(
+                api,
+                self.identity,
+                self.incident_dir
+                or os.path.join("/tmp", f"vtpu-incidents-{self.identity}"),
+                cooldown_s=float(
+                    os.environ.get("VTPU_INCIDENT_COOLDOWN", "60")),
+                boost_ttl_s=float(os.environ.get("VTPU_BOOST_TTL", "30")),
+                metrics_ring=ring,
+                journal_dir=os.environ.get("VTPU_TRACE_JOURNAL", ""),
+                explain_source=explain_source,
+            )
+            self.watchdog = BurnRateWatchdog(
+                ring=ring,
+                fast_window_s=float(
+                    os.environ.get("VTPU_SLO_FAST_WINDOW", "60")),
+                slow_window_s=float(
+                    os.environ.get("VTPU_SLO_SLOW_WINDOW", "300")),
+                period=float(os.environ.get("VTPU_WATCHDOG_PERIOD", "5")),
+                on_breach=self.incidents.on_alert,
+            )
         self.serving = ServingServer(
             host=listen_host, port=listen_port, health_check=self.healthy,
             debug_enabled=debug_enabled, explain_source=explain_source,
+            degraded_source=self._degraded,
         )
         self.elector: Optional[LeaderElector] = None
         if leader_elect:
@@ -104,6 +146,19 @@ class BaseDaemon:
                     log.error("%s cycle failed: %s", self.NAME, e)
             self._stop.wait(self.period)
 
+    def _degraded(self) -> Optional[str]:
+        """/healthz degraded body: open breakers (the serving default)
+        plus the watchdog's active ``slo-burn:<name>`` breaches."""
+        from volcano_tpu.serving.http import _default_degraded
+
+        reasons = []
+        breakers = _default_degraded()
+        if breakers:
+            reasons.append(breakers)
+        if self.watchdog is not None:
+            reasons.extend(self.watchdog.degraded_reasons())
+        return "; ".join(reasons) if reasons else None
+
     def healthy(self) -> bool:
         """Liveness for /healthz: the loop thread must be running (or
         not yet started)."""
@@ -117,6 +172,8 @@ class BaseDaemon:
             from volcano_tpu import obs
 
             self._obs_exporter = obs.enable(self.api, identity=self.identity)
+        if self.watchdog is not None:
+            self.watchdog.start()
         self.serving.start()
         self._on_start()
         if self.elector is not None:
@@ -136,6 +193,8 @@ class BaseDaemon:
             self._thread.join(timeout=10)
         if self.elector is not None:
             self.elector.stop(release=not crash)
+        if self.watchdog is not None:
+            self.watchdog.stop()
         if self._obs_exporter is not None:
             from volcano_tpu import obs
 

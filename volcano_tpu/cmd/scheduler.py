@@ -172,6 +172,22 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
         "segments for `vtctl trace pod/gang` (drop-not-block; also "
         "VTPU_FLIGHT_RECORDER=1; sampling via VTPU_TELEMETRY_SAMPLE)",
     )
+    parser.add_argument(
+        "--watchdog", action="store_true",
+        help="SLO burn-rate watchdog (volcano_tpu/obs/slo.py): "
+        "continuously evaluate declared SLOs over fast/slow windows "
+        "of this process's own metrics; breaches surface on /healthz "
+        "as degraded 'slo-burn:<name>', as volcano_slo_burn gauges, "
+        "and trigger incident bundles (also VTPU_WATCHDOG=1; "
+        "objectives overridable via VTPU_SLO_OBJECTIVES)",
+    )
+    parser.add_argument(
+        "--incident-dir", default=None,
+        help="directory for the bounded on-disk incident-bundle ring "
+        "written when the watchdog breaches or `vtctl incidents "
+        "capture` asks (default /tmp/vtpu-incidents-<identity>; also "
+        "VTPU_INCIDENT_DIR)",
+    )
 
 
 def resolve_bus(bus: str):
@@ -414,6 +430,8 @@ def main(argv=None) -> int:
             identity=args.leader_elect_id,
             debug_enabled=args.enable_debug_stacks,
             flight_recorder=True if args.flight_recorder else None,
+            watchdog=True if args.watchdog else None,
+            incident_dir=args.incident_dir,
         )
     )
 

@@ -197,6 +197,17 @@ class FederatedScheduler:
         }
         if self.metrics_addr:
             out["metricsAddr"] = self.metrics_addr
+        # an active incident capture boost is echoed on the heartbeat
+        # so `vtctl shards` shows which members are recording at full
+        # fidelity, and why (the record itself lives in the telemetry
+        # namespace — this is pure observability)
+        from volcano_tpu import obs
+
+        exporter = obs.get_exporter()
+        if exporter is not None:
+            boost = exporter.boost_record()
+            if boost is not None:
+                out["captureBoost"] = boost
         if self.broker is not None:
             out["gangAssembly"] = self.broker.counters()
         if self.autoscaler is not None:

@@ -851,6 +851,61 @@ def observe_telemetry_batch(size: int) -> None:
     ).observe(size)
 
 
+# ---- tail-based retention + SLO watchdog + incidents (obs/tail,slo,
+# incident).  Same drop-not-block stance: these counters are the only
+# way a squeezed pending pool or a boost-window capture is visible.
+
+
+def register_telemetry_tail_eviction(reason: str) -> None:
+    """volcano_telemetry_tail_evictions_total{reason}: pending-pool
+    traces that could not wait for their completion-time decision and
+    fell back to the head coin.  reason ∈ {pool-full, timeout}."""
+    registry.inc(
+        f"{_NAMESPACE}_telemetry_tail_evictions_total", {"reason": reason}
+    )
+
+
+def register_telemetry_tail_decision(result: str) -> None:
+    """volcano_telemetry_tail_decisions_total{result}: completion-time
+    keep/drop decisions (anomaly keeps, settled coins, peer-resolved).
+    result ∈ {keep, drop}."""
+    registry.inc(
+        f"{_NAMESPACE}_telemetry_tail_decisions_total", {"result": result}
+    )
+
+
+def update_slo_burn(slo: str, window: str, value: float) -> None:
+    """volcano_slo_burn{slo,window}: the burn-rate watchdog's current
+    consumption ratio per declared SLO and evaluation window (>= 1.0
+    in BOTH windows = breach).  window ∈ {fast, slow}."""
+    # label-vocab: slo — the declared SLO names (obs/slo.py
+    # DEFAULT_SLOS, a static per-process set)
+    registry.set_gauge(
+        f"{_NAMESPACE}_slo_burn", {"slo": slo, "window": window}, value
+    )
+
+
+def register_incident_captured(trigger: str) -> None:
+    """volcano_incidents_captured_total{trigger}: incident bundles this
+    daemon wrote."""
+    # label-vocab: trigger — the declared SLO names plus
+    # {manual, watchdog}; routed through bounded_label at the manager
+    # so an operator-shaped reason cannot mint unbounded series
+    registry.inc(
+        f"{_NAMESPACE}_incidents_captured_total",
+        {"trigger": bounded_label(
+            f"{_NAMESPACE}_incidents_captured_total", "trigger", trigger
+        )},
+    )
+
+
+def update_capture_boost(active: float) -> None:
+    """volcano_capture_boost_active: 1 while this daemon's exporter is
+    inside a cluster capture-boost window (sample rate forced to 1.0),
+    else 0."""
+    registry.set_gauge(f"{_NAMESPACE}_capture_boost_active", {}, active)
+
+
 # ---- TPU-build additions: per-kernel phase timings ----
 
 def update_kernel_duration(phase: str, seconds: float) -> None:

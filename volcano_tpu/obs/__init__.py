@@ -33,22 +33,42 @@ null context.
 from __future__ import annotations
 
 from volcano_tpu.obs.channel import (  # noqa: F401
+    BOOST_KEY,
+    BOOST_NAME,
     NAMESPACE,
     SEGMENT_KEY,
     SEGMENT_PREFIX,
+    TAIL_KEY,
+    TAIL_PREFIX,
     SpanExporter,
     disable,
     enable,
 )
 from volcano_tpu.obs.collect import (  # noqa: F401
+    apply_skew,
     build_tree,
     chrome_export,
     collect_spans,
+    estimate_skew,
     related_identities,
     render_waterfall,
     select_trace,
     select_union,
     stage_breakdown,
+)
+from volcano_tpu.obs.incident import (  # noqa: F401
+    INCIDENT_KEY,
+    INCIDENT_PREFIX,
+    IncidentManager,
+    list_incidents,
+    set_capture_boost,
+)
+from volcano_tpu.obs.slo import (  # noqa: F401
+    DEFAULT_SLOS,
+    Alert,
+    BurnRateWatchdog,
+    SLODef,
+    resolve_slos,
 )
 from volcano_tpu.obs.spans import (  # noqa: F401
     Span,
@@ -65,13 +85,29 @@ from volcano_tpu.obs.spans import (  # noqa: F401
     trace_id_for_pod,
 )
 
+from volcano_tpu.obs.tail import TailConfig, TailSampler  # noqa: F401
+
 __all__ = [
+    "Alert",
+    "BOOST_KEY",
+    "BOOST_NAME",
+    "BurnRateWatchdog",
+    "DEFAULT_SLOS",
+    "INCIDENT_KEY",
+    "INCIDENT_PREFIX",
+    "IncidentManager",
     "NAMESPACE",
     "SEGMENT_KEY",
     "SEGMENT_PREFIX",
+    "SLODef",
     "Span",
     "SpanExporter",
+    "TAIL_KEY",
+    "TAIL_PREFIX",
+    "TailConfig",
+    "TailSampler",
     "adopt",
+    "apply_skew",
     "build_tree",
     "chrome_export",
     "collect_spans",
@@ -83,9 +119,13 @@ __all__ = [
     "disable",
     "enable",
     "enabled",
+    "estimate_skew",
     "get_exporter",
+    "list_incidents",
     "render_waterfall",
+    "resolve_slos",
     "select_trace",
+    "set_capture_boost",
     "span",
     "stage_breakdown",
     "suppressed",

@@ -147,6 +147,94 @@ def related_identities(api, namespace: str, name: str) -> List[tuple]:
     return idents
 
 
+def estimate_skew(
+    spans: Iterable[Dict[str, Any]],
+) -> Dict[tuple, float]:
+    """Per-process clock-skew estimate, from the paired client/server
+    ``bus:<op>`` spans bus/remote.py + bus/server.py emit for every
+    traced rpc: same name, linked parent → child, recorded on two
+    different processes' wall clocks.
+
+    Assuming roughly symmetric network delay, the *midpoint* of the
+    client span (send → reply on the client clock) and the midpoint of
+    the server span (handling on the server clock) are the same
+    instant, so their difference IS the relative clock offset — the
+    classic NTP offset estimate, with the rpc as the probe.  Per
+    process-pair the median over all pairs rejects asymmetric-delay
+    outliers; offsets then propagate breadth-first from a
+    deterministic anchor process (the one holding the earliest span),
+    so chained hops (scheduler → apiserver → controllers) re-anchor
+    onto one clock.
+
+    → {(daemon, pid): offset µs to ADD to that process's timestamps}.
+    Empty when no cross-process pair exists (recorder off, single
+    process, or pre-pair segments) — rendering is unchanged then.
+    Deterministic over stored span fields only, so ``vtctl trace``
+    output keeps its byte-identity discipline."""
+    spans = list(spans)
+    by_id = {s.get("s"): s for s in spans}
+    edges: Dict[tuple, Dict[tuple, List[float]]] = {}
+    for child in spans:
+        parent = by_id.get(child.get("p", ""))
+        if parent is None:
+            continue
+        if child.get("cat") != "bus" or parent.get("cat") != "bus":
+            continue
+        if child.get("name") != parent.get("name"):
+            continue
+        ckey = (parent.get("daemon", ""), parent.get("pid", 0))
+        skey = (child.get("daemon", ""), child.get("pid", 0))
+        if ckey == skey:
+            continue
+        off = (
+            (parent.get("ts", 0.0) + parent.get("dur", 0.0) / 2)
+            - (child.get("ts", 0.0) + child.get("dur", 0.0) / 2)
+        )
+        edges.setdefault(ckey, {}).setdefault(skey, []).append(off)
+        edges.setdefault(skey, {}).setdefault(ckey, []).append(-off)
+    if not edges:
+        return {}
+    anchor = None
+    for s in sorted(spans, key=lambda s: (s.get("ts", 0.0), s.get("s", ""))):
+        key = (s.get("daemon", ""), s.get("pid", 0))
+        if key in edges:
+            anchor = key
+            break
+    if anchor is None:
+        return {}
+    offsets: Dict[tuple, float] = {anchor: 0.0}
+    frontier = [anchor]
+    while frontier:
+        nxt = []
+        for node in frontier:
+            for neigh in sorted(edges.get(node, {})):
+                if neigh in offsets:
+                    continue
+                offs = sorted(edges[node][neigh])
+                n = len(offs)
+                median = (
+                    offs[n // 2] if n % 2
+                    else (offs[n // 2 - 1] + offs[n // 2]) / 2
+                )
+                offsets[neigh] = offsets[node] + median
+                nxt.append(neigh)
+        frontier = nxt
+    return offsets
+
+
+def apply_skew(
+    spans: Iterable[Dict[str, Any]], offsets: Dict[tuple, float]
+) -> List[Dict[str, Any]]:
+    """Re-anchor every span's wall timestamp onto the anchor process's
+    clock (durations are perf-measured and untouched)."""
+    out = []
+    for s in spans:
+        off = offsets.get((s.get("daemon", ""), s.get("pid", 0)), 0.0)
+        out.append(dict(s, ts=s.get("ts", 0.0) + off) if off else dict(s))
+    out.sort(key=lambda s: (s.get("ts", 0.0), s.get("s", "")))
+    return out
+
+
 def build_tree(spans: List[Dict[str, Any]]):
     """→ (roots, children) with children keyed by span id, both in
     start-time order.  A span whose parent is not in the set is a
@@ -166,15 +254,31 @@ def build_tree(spans: List[Dict[str, Any]]):
 def render_waterfall(
     spans: List[Dict[str, Any]], out: TextIO,
     clock0_us: Optional[float] = None,
+    skew: Optional[Dict[tuple, float]] = None,
 ) -> None:
     """Text waterfall: one line per span, indented by tree depth, with
     offset from the earliest span and duration — the submit→bind
-    decomposition at a glance.  Offsets share one wall-clock origin
-    across processes (obs/spans.py docstring notes the skew caveat)."""
+    decomposition at a glance.  Cross-process timestamps are
+    re-anchored onto one clock via :func:`estimate_skew` (pass
+    ``skew={}`` for raw wall clocks); when a correction was applied a
+    header line reports the estimated per-process offsets."""
     if not spans:
         print("no spans recorded for this identity "
               "(is the flight recorder enabled? sampled out?)", file=out)
         return
+    if skew is None:
+        skew = estimate_skew(spans)
+    corrections = {
+        k: v for k, v in (skew or {}).items() if abs(v) >= 1.0
+    }
+    if corrections:
+        spans = apply_skew(spans, skew)
+        parts = "; ".join(
+            f"{daemon or '?'}/{pid} {off / 1e3:+.2f}ms"
+            for (daemon, pid), off in sorted(corrections.items())
+        )
+        print(f"clock skew corrected (paired bus-span RTT midpoints): "
+              f"{parts}", file=out)
     roots, children = build_tree(spans)
     t0 = clock0_us if clock0_us is not None else min(
         s.get("ts", 0.0) for s in spans

@@ -21,8 +21,12 @@ bump).
 Timestamps are wall-clock microseconds (``time.time()``), the shared
 clock origin that lets per-process timelines merge; durations are
 ``perf_counter`` so they stay monotonic.  Cross-host clock skew is
-therefore visible as span-edge misalignment, never as wrong durations
-(README "Observability" states this honestly).
+estimated and corrected at render time: every traced rpc emits a
+paired client/server ``bus:<op>`` span, and obs/collect.py's
+:func:`~volcano_tpu.obs.collect.estimate_skew` turns their RTT
+midpoints into per-process offsets (median per hop, propagated from a
+deterministic anchor) — so waterfalls re-anchor onto one clock
+instead of showing raw misalignment.
 
 Zero-cost when disabled: every emission checks the module-level
 exporter first, and :func:`span` returns a shared null context manager
@@ -122,10 +126,11 @@ class Span:
     wall-clock µs at entry; ``dur`` perf-measured µs."""
 
     __slots__ = ("exporter", "name", "cat", "trace_id", "span_id",
-                 "parent_id", "args", "_t0", "_wall0")
+                 "parent_id", "args", "rooted", "_t0", "_wall0")
 
     def __init__(self, exporter, name: str, cat: str, trace_id: str,
-                 parent_id: str, args: Optional[Dict[str, Any]]):
+                 parent_id: str, args: Optional[Dict[str, Any]],
+                 rooted: bool = False):
         self.exporter = exporter
         self.name = name
         self.cat = cat
@@ -133,6 +138,10 @@ class Span:
         self.span_id = _next_span_id(exporter.token)
         self.parent_id = parent_id
         self.args = args
+        #: an explicit trace_id re-rooted this span under a pod/gang
+        #: identity — the tail sampler's trace-completion signal (the
+        #: transient "_root" record key; stripped before export)
+        self.rooted = rooted
 
     def __enter__(self) -> "Span":
         self._wall0 = time.time()
@@ -158,6 +167,7 @@ class Span:
             "dur": (time.perf_counter() - self._t0) * 1e6,
             "tid": threading.get_ident(),
             **({"args": args} if args else {}),
+            **({"_root": True} if self.rooted else {}),
         })
         return False
 
@@ -217,7 +227,8 @@ def span(name: str, cat: str = "span", trace_id: Optional[str] = None,
     tid = trace_id if trace_id is not None else inherited
     if not exp.keep(tid):
         return _DroppedSpan(exp.token, tid)
-    return Span(exp, name, cat, tid, parent, args)
+    return Span(exp, name, cat, tid, parent, args,
+                rooted=bool(tid) and tid != inherited)
 
 
 def adopt(wire: Optional[Dict[str, str]], name: str, cat: str = "span",
@@ -267,6 +278,7 @@ def complete(name: str, seconds: float, cat: str = "span",
         "dur": seconds * 1e6,
         "tid": threading.get_ident(),
         **({"args": args} if args else {}),
+        **({"_root": True} if bool(tid) and tid != inherited else {}),
     })
 
 
